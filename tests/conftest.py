@@ -1,0 +1,17 @@
+"""Test configuration: force a virtual 8-device CPU platform BEFORE jax import.
+
+This is the standard way to exercise pjit/shard_map sharding without a TPU pod
+(SURVEY §4): tests that need a mesh get 8 host devices; everything else just
+runs on CPU for speed and determinism.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_default_matmul_precision", "highest")

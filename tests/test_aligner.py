@@ -1,0 +1,100 @@
+"""seq_aligner + schedules golden tests (reference semantics:
+/root/reference/seq_aligner.py, /root/reference/ptp_utils.py:258-310)."""
+
+import numpy as np
+
+from videop2p_tpu.control import (
+    get_refinement_mapper,
+    get_replacement_mapper,
+    get_time_words_attention_alpha,
+    get_word_inds,
+)
+from videop2p_tpu.utils.tokenizers import WordTokenizer
+
+
+def tok():
+    return WordTokenizer()
+
+
+def test_get_word_inds_word_and_index():
+    t = tok()
+    text = "a silver jeep driving down a curvy road"
+    np.testing.assert_array_equal(get_word_inds(text, "jeep", t), [3])
+    np.testing.assert_array_equal(get_word_inds(text, 1, t), [2])
+    # repeated word: all occurrences
+    np.testing.assert_array_equal(get_word_inds(text, "a", t), [1, 6])
+    assert get_word_inds(text, "absent", t).size == 0
+
+
+def test_refinement_mapper_identical_prompts():
+    t = tok()
+    m, a = get_refinement_mapper(["a cat runs", "a cat runs"], t)
+    assert m.shape == (1, 77) and a.shape == (1, 77)
+    # perfect alignment → identity mapper with alpha 1 everywhere
+    np.testing.assert_array_equal(m[0][:5], [0, 1, 2, 3, 4])
+    assert a.min() == 1.0
+
+
+def test_refinement_mapper_insertion():
+    t = tok()
+    src = "a rabbit is jumping"
+    tgt = "a origami rabbit is jumping"
+    m, a = get_refinement_mapper([src, tgt], t)
+    # token layout: [BOS, a, origami, rabbit, is, jumping, EOS]
+    # 'origami' (pos 2) has no source counterpart → alpha 0
+    assert a[0, 2] == 0.0
+    # aligned words map back to their source positions
+    assert m[0, 1] == 1  # 'a' → 'a'
+    assert m[0, 3] == 2  # 'rabbit' → 'rabbit'
+    assert m[0, 5] == 4  # 'jumping' → 'jumping'
+    # padding region: identity continuation
+    n_tgt = len(t.encode(tgt))
+    np.testing.assert_array_equal(m[0, n_tgt:], np.arange(n_tgt, 77))
+    assert np.all(a[0, n_tgt:] == 1.0)
+
+
+def test_replacement_mapper_word_swap():
+    t = tok()
+    src = "a silver jeep driving down a road"
+    tgt = "a silver bike driving down a road"
+    m = get_replacement_mapper([src, tgt], t)
+    assert m.shape == (1, 77, 77)
+    m0 = m[0]
+    # swapped word: jeep(pos 3) → bike(pos 3)
+    assert m0[3, 3] == 1.0
+    # all other positions identity
+    diag = np.diag(m0)
+    assert np.all(diag[:3] == 1.0) and np.all(diag[4:10] == 1.0)
+    # each target column sums to 1 over source rows in the prompt region
+    np.testing.assert_allclose(m0[:10].sum(axis=0)[:10], np.ones(10), rtol=1e-6)
+
+
+def test_replacement_mapper_unequal_lengths_raises():
+    t = tok()
+    import pytest
+
+    with pytest.raises(ValueError):
+        get_replacement_mapper(["a cat", "a big cat"], t)
+
+
+def test_time_words_alpha_default_window():
+    t = tok()
+    prompts = ["a cat", "a dog"]
+    alpha = get_time_words_attention_alpha(prompts, 50, 0.2, t)
+    assert alpha.shape == (51, 1, 1, 1, 77)
+    # active for steps [0, 10), zero after
+    assert np.all(alpha[:10, 0, 0, 0, :] == 1.0)
+    assert np.all(alpha[10:, 0, 0, 0, :] == 0.0)
+
+
+def test_time_words_alpha_per_word_override():
+    t = tok()
+    prompts = ["a cat runs", "a dog runs"]
+    alpha = get_time_words_attention_alpha(
+        prompts, 10, {"default_": 0.5, "dog": (0.0, 1.0)}, t
+    )
+    dog_ind = get_word_inds(prompts[1], "dog", t)[0]
+    # dog stays active through all steps; others stop at step 5
+    assert np.all(alpha[:, 0, 0, 0, dog_ind] == 1.0)
+    other = 1  # word 'a'
+    assert np.all(alpha[5:, 0, 0, 0, other] == 0.0)

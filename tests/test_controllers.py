@@ -1,0 +1,148 @@
+"""Functional controller tests on synthetic attention tensors
+(reference semantics: /root/reference/run_videop2p.py:286-410)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from videop2p_tpu.control import ControlContext, control_attention, make_controller
+from videop2p_tpu.control.controllers import get_equalizer
+from videop2p_tpu.utils.tokenizers import WordTokenizer
+
+P, F, H, Q, W = 2, 2, 2, 4, 77
+STEPS = 10
+
+
+def _probs(key, b):
+    x = jax.random.uniform(key, (b, H, Q, W))
+    return x / x.sum(-1, keepdims=True)
+
+
+def _ctx(**kw):
+    t = WordTokenizer()
+    defaults = dict(
+        is_replace_controller=False,
+        cross_replace_steps=0.8,
+        self_replace_steps=0.5,
+    )
+    defaults.update(kw)
+    return make_controller(
+        ["a rabbit is jumping", "a origami rabbit is jumping"], t, STEPS, **defaults
+    ), t
+
+
+def test_uncond_half_untouched():
+    ctx, _ = _ctx()
+    probs = _probs(jax.random.PRNGKey(0), 2 * P * F)
+    out = control_attention(probs, ctx, is_cross=True, step_index=jnp.asarray(0), video_length=F)
+    np.testing.assert_array_equal(np.asarray(out[: P * F]), np.asarray(probs[: P * F]))
+    assert not np.allclose(np.asarray(out[P * F :]), np.asarray(probs[P * F :]))
+
+
+def test_none_context_is_identity():
+    probs = _probs(jax.random.PRNGKey(1), 2 * P * F)
+    out = control_attention(probs, None, is_cross=True, step_index=jnp.asarray(0), video_length=F)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(probs))
+
+
+def test_refine_matches_reference_math():
+    ctx, _ = _ctx()
+    probs = _probs(jax.random.PRNGKey(2), 2 * P * F)
+    step = jnp.asarray(0)
+    out = control_attention(probs, ctx, is_cross=True, step_index=step, video_length=F)
+
+    p = np.asarray(probs).reshape(2, P, F, H, Q, W)
+    base, repl = p[1, 0], p[1, 1]
+    mapper = np.asarray(ctx.refine_mapper[0])
+    alphas = np.asarray(ctx.refine_alphas[0])
+    gathered = base[..., mapper]  # (F,H,Q,W)
+    refined = gathered * alphas + repl * (1 - alphas)
+    alpha_words = np.asarray(ctx.cross_replace_alpha)[0, 0, 0, 0]  # (77,)
+    expected = refined * alpha_words + (1 - alpha_words) * repl
+
+    got = np.asarray(out).reshape(2, P, F, H, Q, W)[1, 1]
+    np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-7)
+    # base stream passes through
+    np.testing.assert_allclose(np.asarray(out).reshape(2, P, F, H, Q, W)[1, 0], base, rtol=1e-6)
+
+
+def test_cross_replace_alpha_gates_late_steps():
+    ctx, _ = _ctx(cross_replace_steps=0.2)
+    probs = _probs(jax.random.PRNGKey(3), 2 * P * F)
+    late = control_attention(probs, ctx, is_cross=True, step_index=jnp.asarray(STEPS - 1), video_length=F)
+    # with alpha=0 everywhere, edit stream is untouched
+    np.testing.assert_allclose(np.asarray(late), np.asarray(probs), rtol=1e-6)
+
+
+def test_replace_controller_word_swap():
+    t = WordTokenizer()
+    ctx = make_controller(
+        ["a silver jeep driving", "a silver bike driving"],
+        t,
+        STEPS,
+        is_replace_controller=True,
+        cross_replace_steps=1.0,
+        self_replace_steps=0.5,
+    )
+    probs = _probs(jax.random.PRNGKey(4), 2 * P * F)
+    out = control_attention(probs, ctx, is_cross=True, step_index=jnp.asarray(0), video_length=F)
+    p = np.asarray(probs).reshape(2, P, F, H, Q, W)
+    base = p[1, 0]
+    mapper = np.asarray(ctx.replace_mapper[0])
+    expected = np.einsum("fhqw,wn->fhqn", base, mapper)
+    got = np.asarray(out).reshape(2, P, F, H, Q, W)[1, 1]
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-7)
+
+
+def test_reweight_scales_words():
+    t = WordTokenizer()
+    prompts = ["a rabbit jumping", "a origami rabbit jumping"]
+    eq_params = {"words": ["origami"], "values": [4.0]}
+    ctx = make_controller(
+        prompts, t, STEPS,
+        is_replace_controller=False,
+        cross_replace_steps=1.0,
+        self_replace_steps=0.5,
+        equalizer_params=eq_params,
+    )
+    eq = get_equalizer(prompts[1], ["origami"], [4.0], t)
+    assert eq[0, 2] == 4.0 and eq[0, 1] == 1.0
+
+    probs = _probs(jax.random.PRNGKey(5), 2 * P * F)
+    out = control_attention(probs, ctx, is_cross=True, step_index=jnp.asarray(0), video_length=F)
+    p = np.asarray(probs).reshape(2, P, F, H, Q, W)
+    base = p[1, 0]
+    mapper = np.asarray(ctx.refine_mapper[0])
+    alphas = np.asarray(ctx.refine_alphas[0])
+    refined = base[..., mapper] * alphas + p[1, 1] * (1 - alphas)
+    expected = refined * np.asarray(eq)[0]
+    got = np.asarray(out).reshape(2, P, F, H, Q, W)[1, 1]
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-7)
+
+
+def test_temporal_replace_window():
+    ctx, _ = _ctx(self_replace_steps=0.5)  # active for steps [0, 5)
+    D = 4
+    probs = _probs(jax.random.PRNGKey(6), 2 * P * D)  # (B, H, Q=4, W=77) stands in for (B,H,F,F)
+    probs = probs[..., :4]  # (B, H, 4, 4) square temporal maps over 4 frames
+    early = control_attention(probs, ctx, is_cross=False, step_index=jnp.asarray(0), video_length=4)
+    late = control_attention(probs, ctx, is_cross=False, step_index=jnp.asarray(5), video_length=4)
+
+    p = np.asarray(probs).reshape(2, P, D, probs.shape[1], 4, 4)
+    e = np.asarray(early).reshape(2, P, D, probs.shape[1], 4, 4)
+    # early: edit stream replaced by base
+    np.testing.assert_allclose(e[1, 1], p[1, 0], rtol=1e-6)
+    # late: untouched
+    np.testing.assert_allclose(np.asarray(late), np.asarray(probs), rtol=1e-6)
+
+
+def test_control_attention_jittable_under_scan():
+    ctx, _ = _ctx()
+    probs = _probs(jax.random.PRNGKey(7), 2 * P * F)
+
+    def body(carry, step):
+        out = control_attention(probs, ctx, is_cross=True, step_index=step, video_length=F)
+        return carry, out.sum()
+
+    _, sums = jax.lax.scan(body, 0.0, jnp.arange(STEPS))
+    assert sums.shape == (STEPS,)

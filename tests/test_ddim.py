@@ -1,0 +1,112 @@
+"""Scheduler unit tests: golden values vs. closed-form DDIM math
+(reference semantics: /root/reference/dependent_ddim.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from videop2p_tpu.core import DDIMScheduler, DDPMScheduler
+
+
+def test_beta_schedule_scaled_linear_matches_sd():
+    s = DDIMScheduler.create_sd()
+    # SD-1.x: betas linear in sqrt space between 0.00085 and 0.012
+    betas = np.linspace(0.00085**0.5, 0.012**0.5, 1000) ** 2
+    ac = np.cumprod(1 - betas)
+    np.testing.assert_allclose(np.asarray(s.alphas_cumprod), ac, rtol=1e-5)
+    # set_alpha_to_one=False -> final alpha is alphas_cumprod[0]
+    np.testing.assert_allclose(float(s.final_alpha_cumprod), ac[0], rtol=1e-6)
+
+
+def test_timesteps_grid():
+    s = DDIMScheduler.create_sd()
+    ts = s.timesteps(50)
+    assert ts.shape == (50,)
+    assert ts[0] == 980 and ts[-1] == 0
+    assert np.all(np.diff(ts) == -20)
+
+
+def test_step_eta0_closed_form():
+    s = DDIMScheduler.create_sd()
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (1, 4, 8, 8, 4))
+    eps = jax.random.normal(jax.random.PRNGKey(1), x.shape)
+    t = jnp.asarray(980)
+    prev, x0 = s.step(eps, t, x, 50)
+
+    a_t = np.asarray(s.alphas_cumprod)[980]
+    a_prev = np.asarray(s.alphas_cumprod)[960]
+    x0_ref = (np.asarray(x) - np.sqrt(1 - a_t) * np.asarray(eps)) / np.sqrt(a_t)
+    prev_ref = np.sqrt(a_prev) * x0_ref + np.sqrt(1 - a_prev) * np.asarray(eps)
+    np.testing.assert_allclose(np.asarray(x0), x0_ref, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(prev), prev_ref, rtol=1e-4, atol=1e-5)
+
+
+def test_step_final_timestep_uses_final_alpha():
+    s = DDIMScheduler.create_sd()
+    x = jnp.ones((1, 2, 4, 4, 4))
+    eps = jnp.zeros_like(x)
+    prev, x0 = s.step(eps, jnp.asarray(0), x, 50)
+    a_t = np.asarray(s.alphas_cumprod)[0]
+    x0_ref = np.asarray(x) / np.sqrt(a_t)
+    # prev alpha == final_alpha_cumprod == alphas_cumprod[0]
+    np.testing.assert_allclose(np.asarray(prev), np.sqrt(a_t) * x0_ref, rtol=1e-5)
+
+
+def test_next_prev_roundtrip():
+    """Forward (inversion) then reverse step with the same model output is an
+    exact inverse — the property null-text optimization relies on."""
+    s = DDIMScheduler.create_sd()
+    x = jax.random.normal(jax.random.PRNGKey(2), (1, 4, 8, 8, 4))
+    eps = jax.random.normal(jax.random.PRNGKey(3), x.shape)
+    t = jnp.asarray(500)
+    up = s.next_step(eps, t, x, 50)
+    down = s.prev_step(eps, t, up, 50)
+    np.testing.assert_allclose(np.asarray(down), np.asarray(x), rtol=1e-3, atol=1e-4)
+
+
+def test_eta_variance_injection():
+    s = DDIMScheduler.create_sd()
+    x = jax.random.normal(jax.random.PRNGKey(4), (1, 4, 4, 4, 4))
+    eps = jax.random.normal(jax.random.PRNGKey(5), x.shape)
+    noise = jax.random.normal(jax.random.PRNGKey(6), x.shape)
+    t = jnp.asarray(500)
+    prev0, _ = s.step(eps, t, x, 50, eta=0.0)
+    prev1, _ = s.step(eps, t, x, 50, eta=0.1, variance_noise=noise)
+    var = float(s.variance(t, t - 20))
+    delta = np.asarray(prev1) - np.asarray(prev0)
+    # x_{t-1} shifts by η·σ_t·noise plus the direction-term correction
+    a_prev = np.asarray(s.alphas_cumprod)[480]
+    std = 0.1 * np.sqrt(var)
+    dir_corr = (np.sqrt(1 - a_prev - std**2) - np.sqrt(1 - a_prev)) * np.asarray(eps)
+    np.testing.assert_allclose(delta, std * np.asarray(noise) + dir_corr, rtol=1e-3, atol=1e-5)
+
+    with pytest.raises(ValueError):
+        s.step(eps, t, x, 50, eta=0.1)
+
+
+def test_step_jittable_with_traced_timestep():
+    s = DDIMScheduler.create_sd()
+
+    @jax.jit
+    def f(sched, eps, t, x):
+        return sched.step(eps, t, x, 50)[0]
+
+    x = jnp.ones((1, 2, 4, 4, 4))
+    out = f(s, jnp.zeros_like(x), jnp.asarray(20), x)
+    assert out.shape == x.shape
+
+
+def test_add_noise_and_velocity():
+    s = DDPMScheduler.create_sd(prediction_type="v_prediction")
+    x = jax.random.normal(jax.random.PRNGKey(7), (2, 4, 8, 8, 4))
+    n = jax.random.normal(jax.random.PRNGKey(8), x.shape)
+    t = jnp.asarray([100, 700])
+    noisy = s.add_noise(x, n, t)
+    v = s.get_velocity(x, n, t)
+    a = np.sqrt(np.asarray(s.alphas_cumprod)[np.asarray(t)])[:, None, None, None, None]
+    b = np.sqrt(1 - np.asarray(s.alphas_cumprod)[np.asarray(t)])[:, None, None, None, None]
+    np.testing.assert_allclose(np.asarray(noisy), a * np.asarray(x) + b * np.asarray(n), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(v), a * np.asarray(n) - b * np.asarray(x), rtol=1e-4, atol=1e-5)
+    assert s.training_target(x, n, t) is v or np.allclose(np.asarray(s.training_target(x, n, t)), np.asarray(v))

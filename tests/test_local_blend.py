@@ -1,0 +1,83 @@
+"""LocalBlend tests on synthetic attention maps
+(reference semantics: /root/reference/run_videop2p.py:129-181)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from videop2p_tpu.control import make_local_blend, local_blend
+from videop2p_tpu.control.local_blend import _get_mask, _max_pool_3x3
+from videop2p_tpu.utils.tokenizers import WordTokenizer
+from videop2p_tpu.control.schedules import get_word_inds
+
+P, F, S, R = 2, 2, 5, 16
+HW = 32
+
+
+def _cfg(start_blend=0.2, num_steps=50):
+    tok = WordTokenizer()
+    prompts = ["a rabbit is jumping", "a origami rabbit is jumping"]
+    cfg = make_local_blend(
+        prompts, (("rabbit",), ("origami", "rabbit")), tok, num_steps, start_blend=start_blend
+    )
+    word_inds = {
+        "src_rabbit": get_word_inds(prompts[0], "rabbit", tok),
+        "tgt_origami": get_word_inds(prompts[1], "origami", tok),
+    }
+    return cfg, word_inds
+
+
+def _maps_with_hotspot(word_inds):
+    """Cross-attn maps where the blend words attend to the top-left corner."""
+    maps = np.full((P, F, S, R, R, 77), 1e-4, dtype=np.float32)
+    maps[0, :, :, :4, :4, word_inds["src_rabbit"][0]] = 1.0
+    maps[1, :, :, :4, :4, word_inds["tgt_origami"][0]] = 1.0
+    return jnp.asarray(maps)
+
+
+def test_alpha_layers_mark_blend_words():
+    cfg, wi = _cfg()
+    assert cfg.alpha_layers.shape == (P, 1, 77)
+    assert cfg.alpha_layers[0, 0, wi["src_rabbit"][0]] == 1.0
+    assert cfg.alpha_layers[1, 0, wi["tgt_origami"][0]] == 1.0
+    assert cfg.alpha_layers.sum() == 3.0  # rabbit + (origami, rabbit)
+    assert cfg.start_blend == 10
+
+
+def test_mask_localizes_to_hotspot():
+    cfg, wi = _cfg()
+    maps = _maps_with_hotspot(wi)
+    mask = _get_mask(maps, cfg.alpha_layers[:, 0, :], True, (HW, HW), cfg.th)
+    mask = np.asarray(mask)
+    assert mask.shape == (P, F, HW, HW)
+    # hotspot (top-left quarter) is masked, bottom-right is not
+    assert mask[:, :, :6, :6].all()
+    assert not mask[:, :, 16:, 16:].any()
+
+
+def test_blend_outside_mask_pulls_to_source():
+    cfg, wi = _cfg()
+    maps = _maps_with_hotspot(wi)
+    x = jnp.asarray(np.random.RandomState(0).randn(P, F, HW, HW, 4).astype(np.float32))
+    out = local_blend(x, maps, cfg, jnp.asarray(20))
+    out = np.asarray(out)
+    # source stream always unchanged
+    np.testing.assert_allclose(out[0], np.asarray(x)[0], rtol=1e-6)
+    # outside the mask the edit stream equals the source stream
+    np.testing.assert_allclose(out[1, :, 20:, 20:], np.asarray(x)[0, :, 20:, 20:], rtol=1e-6)
+    # inside the mask the edit stream is kept (x0 + (x1-x0) ≈ x1 up to fp assoc.)
+    np.testing.assert_allclose(out[1, :, :4, :4], np.asarray(x)[1, :, :4, :4], rtol=1e-5, atol=1e-6)
+
+
+def test_blend_inactive_before_start():
+    cfg, wi = _cfg()
+    maps = _maps_with_hotspot(wi)
+    x = jnp.asarray(np.random.RandomState(1).randn(P, F, HW, HW, 4).astype(np.float32))
+    out = local_blend(x, maps, cfg, jnp.asarray(5))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x), rtol=1e-6)
+
+
+def test_max_pool_window():
+    x = jnp.zeros((1, 1, 5, 5)).at[0, 0, 2, 2].set(1.0)
+    pooled = np.asarray(_max_pool_3x3(x))
+    assert pooled[0, 0, 1:4, 1:4].min() == 1.0
+    assert pooled[0, 0, 0, 0] == 0.0

@@ -1,0 +1,93 @@
+"""Dependent-noise sampler: distributional tests against closed-form
+covariances (reference semantics: /root/reference/dependent_noise.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from videop2p_tpu.core import DependentNoiseSampler
+from videop2p_tpu.core.noise import ar_window_cov, toeplitz_cov
+
+
+def test_toeplitz_cov():
+    cov = toeplitz_cov(4, 0.5)
+    expected = np.array(
+        [
+            [1.0, 0.5, 0.25, 0.125],
+            [0.5, 1.0, 0.5, 0.25],
+            [0.25, 0.5, 1.0, 0.5],
+            [0.125, 0.25, 0.5, 1.0],
+        ],
+        dtype=np.float32,
+    )
+    np.testing.assert_allclose(cov, expected)
+
+
+def test_ar_window_cov_kron():
+    ws, dr, ac, nw = 3, 0.3, 0.25, 2
+    cov = ar_window_cov(ws, dr, ac, nw)
+    inner = toeplitz_cov(ws, dr)
+    # cross-window block scales by sqrt(ac)^|i-j|
+    np.testing.assert_allclose(cov[:ws, :ws], inner, rtol=1e-6)
+    np.testing.assert_allclose(cov[:ws, ws:], np.sqrt(ac) * inner, rtol=1e-6)
+
+
+def _empirical_cov(samples: np.ndarray) -> np.ndarray:
+    # samples: (N, f)
+    return (samples.T @ samples) / samples.shape[0]
+
+
+def test_single_window_covariance():
+    s = DependentNoiseSampler.create(num_frames=8, decay_rate=0.4, window_size=8)
+    draws = s.sample(jax.random.PRNGKey(0), (4096, 8, 2), frame_axis=1)
+    flat = np.asarray(draws).transpose(0, 2, 1).reshape(-1, 8)
+    emp = _empirical_cov(flat)
+    np.testing.assert_allclose(emp, s.joint_cov(), atol=0.08)
+
+
+def test_independent_windows():
+    s = DependentNoiseSampler.create(num_frames=8, decay_rate=0.5, window_size=4, ar_sample=False)
+    draws = s.sample(jax.random.PRNGKey(1), (8192, 8), frame_axis=1)
+    emp = _empirical_cov(np.asarray(draws))
+    ref = s.joint_cov()
+    # off-diagonal window block must be ~0
+    np.testing.assert_allclose(emp[:4, 4:], np.zeros((4, 4)), atol=0.08)
+    np.testing.assert_allclose(emp[:4, :4], ref[:4, :4], atol=0.08)
+
+
+def test_ar_chained_windows_covariance():
+    """AR chaining realizes kron(toeplitz(sqrt(ac)^|i-j|), Σ)
+    (dependent_noise.py:59-71 vs :17-20)."""
+    s = DependentNoiseSampler.create(
+        num_frames=12, decay_rate=0.3, window_size=4, ar_sample=True, ar_coeff=0.36
+    )
+    draws = s.sample(jax.random.PRNGKey(2), (16384, 12), frame_axis=1)
+    emp = _empirical_cov(np.asarray(draws))
+    np.testing.assert_allclose(emp, s.joint_cov(), atol=0.1)
+
+
+def test_sample_like_layout_and_dtype():
+    s = DependentNoiseSampler.create(num_frames=8, window_size=8)
+    x = jnp.zeros((2, 8, 16, 16, 4), dtype=jnp.bfloat16)
+    n = s.sample_like(jax.random.PRNGKey(3), x)
+    assert n.shape == x.shape and n.dtype == x.dtype
+
+
+def test_frame_axis_mismatch_raises():
+    s = DependentNoiseSampler.create(num_frames=8, window_size=8)
+    with pytest.raises(ValueError):
+        s.sample(jax.random.PRNGKey(0), (2, 6, 4), frame_axis=1)
+    with pytest.raises(ValueError):
+        DependentNoiseSampler.create(num_frames=10, window_size=4)
+
+
+def test_sampler_jittable():
+    s = DependentNoiseSampler.create(num_frames=8, window_size=4, ar_sample=True)
+
+    @jax.jit
+    def draw(sampler, key):
+        return sampler.sample(key, (2, 8, 4, 4, 4), frame_axis=1)
+
+    out = draw(s, jax.random.PRNGKey(9))
+    assert out.shape == (2, 8, 4, 4, 4)

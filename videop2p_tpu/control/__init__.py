@@ -1,0 +1,29 @@
+"""Attention control (prompt-to-prompt) layer — pure functions, no hooks."""
+
+from videop2p_tpu.control.seq_aligner import (
+    get_refinement_mapper,
+    get_replacement_mapper,
+)
+from videop2p_tpu.control.schedules import (
+    get_word_inds,
+    get_time_words_attention_alpha,
+)
+from videop2p_tpu.control.controllers import (
+    ControlContext,
+    make_controller,
+    control_attention,
+)
+from videop2p_tpu.control.local_blend import LocalBlendConfig, make_local_blend, local_blend
+
+__all__ = [
+    "get_refinement_mapper",
+    "get_replacement_mapper",
+    "get_word_inds",
+    "get_time_words_attention_alpha",
+    "ControlContext",
+    "make_controller",
+    "control_attention",
+    "LocalBlendConfig",
+    "make_local_blend",
+    "local_blend",
+]

@@ -1,0 +1,233 @@
+"""Functional prompt-to-prompt attention control.
+
+The reference implements control as monkey-patched attention forwards with
+hidden step/layer counters (ptp_utils.py:188-255, run_videop2p.py:196-410).
+Here control is a *pure function* over attention probabilities:
+
+    probs' = control_attention(probs, ctx, is_cross=..., step_index=...)
+
+with all schedule state precomputed into a :class:`ControlContext` pytree and
+the step index supplied by the enclosing ``lax.scan``. Controlled sites are
+the text cross-attention and the temporal attention — NOT the spatial frame
+attention — matching the reference's patch rule which only rebinds modules
+named ``CrossAttention`` (ptp_utils.py:236-239; see SURVEY §3.4).
+
+Edit semantics preserved:
+  * only the conditional (CFG) half is edited (run_videop2p.py:212-218);
+  * cross-attention: base-stream maps are mapped into each edit stream
+    (replace: soft 77×77 permutation, run_videop2p.py:331-339; refine:
+    per-token gather + alpha blend, :342-354), optionally reweighted by a
+    per-word equalizer (:357-369), then time-gated by cross_replace_alpha
+    (:311-313);
+  * temporal ("self") attention: base maps broadcast to every edit stream
+    inside the [lo, hi) step window (:293-298, :306, :314-315).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from videop2p_tpu.control import seq_aligner
+from videop2p_tpu.control.local_blend import LocalBlendConfig, make_local_blend
+from videop2p_tpu.control.schedules import get_time_words_attention_alpha, get_word_inds
+from videop2p_tpu.utils.tokenizers import MAX_NUM_WORDS, Tokenizer
+
+__all__ = ["ControlContext", "make_controller", "control_attention", "get_equalizer"]
+
+
+class ControlContext(struct.PyTreeNode):
+    """All state an attention edit needs, as one pytree.
+
+    ``kind`` selects the cross edit; array fields not used by that kind are
+    None. ``num_prompts`` counts conditional streams (source + edits).
+    """
+
+    cross_replace_alpha: jax.Array  # (num_steps+1, n_edits, 1, 1, 77)
+    refine_mapper: Optional[jax.Array] = None  # (n_edits, 77) int32
+    refine_alphas: Optional[jax.Array] = None  # (n_edits, 77)
+    replace_mapper: Optional[jax.Array] = None  # (n_edits, 77, 77)
+    equalizer: Optional[jax.Array] = None  # (n_edits, 77)
+    blend: Optional[LocalBlendConfig] = None
+
+    kind: str = struct.field(pytree_node=False, default="refine")
+    num_prompts: int = struct.field(pytree_node=False, default=2)
+    self_replace_range: Tuple[int, int] = struct.field(pytree_node=False, default=(0, 0))
+
+    @property
+    def n_edits(self) -> int:
+        return self.num_prompts - 1
+
+
+def get_equalizer(
+    text: str,
+    words: Sequence[str],
+    values: Sequence[float],
+    tokenizer: Tokenizer,
+    max_len: int = MAX_NUM_WORDS,
+) -> np.ndarray:
+    """Per-token attention rescale factors (run_videop2p.py:372-381)."""
+    eq = np.ones((1, max_len), dtype=np.float32)
+    if isinstance(words, str):
+        words = (words,)
+    for word, val in zip(words, values):
+        inds = get_word_inds(text, word, tokenizer)
+        eq[:, inds] = float(val)
+    return eq
+
+
+def make_controller(
+    prompts: Sequence[str],
+    tokenizer: Tokenizer,
+    num_steps: int,
+    *,
+    is_replace_controller: bool,
+    cross_replace_steps,
+    self_replace_steps,
+    blend_words: Optional[Tuple[Sequence[str], Sequence[str]]] = None,
+    equalizer_params: Optional[Dict] = None,
+    mask_th: Tuple[float, float] = (0.3, 0.3),
+    start_blend: float = 0.2,
+) -> ControlContext:
+    """Build the edit context for a pair/list of prompts
+    (run_videop2p.py:397-410).
+
+    Word-swap edits use a replace controller, otherwise refine; an optional
+    equalizer adds a reweight stage; ``blend_words`` adds a LocalBlend mask.
+    """
+    n_prompts = len(prompts)
+    if n_prompts < 2:
+        raise ValueError(
+            "attention control needs a source prompt plus at least one edit "
+            f"prompt, got {n_prompts} prompt(s)"
+        )
+    cra = get_time_words_attention_alpha(prompts, num_steps, cross_replace_steps, tokenizer)
+
+    refine_mapper = refine_alphas = replace_mapper = None
+    if is_replace_controller:
+        replace_mapper = jnp.asarray(seq_aligner.get_replacement_mapper(prompts, tokenizer))
+        kind = "replace"
+    else:
+        m, a = seq_aligner.get_refinement_mapper(prompts, tokenizer)
+        refine_mapper = jnp.asarray(m.astype(np.int32))
+        refine_alphas = jnp.asarray(a)
+        kind = "refine"
+
+    equalizer = None
+    if equalizer_params is not None:
+        eq = get_equalizer(
+            prompts[1], equalizer_params["words"], equalizer_params["values"], tokenizer
+        )
+        # one equalizer row per edit stream (reference computes it from
+        # prompts[1] and applies it to all, run_videop2p.py:362)
+        equalizer = jnp.asarray(np.broadcast_to(eq, (n_prompts - 1, eq.shape[1])).copy())
+
+    blend = None
+    if blend_words is not None:
+        blend = make_local_blend(
+            prompts, blend_words, tokenizer, num_steps,
+            th=mask_th, start_blend=start_blend,
+        )
+
+    if isinstance(self_replace_steps, (int, float)):
+        self_replace_steps = (0.0, float(self_replace_steps))
+    srr = (int(num_steps * self_replace_steps[0]), int(num_steps * self_replace_steps[1]))
+
+    return ControlContext(
+        cross_replace_alpha=jnp.asarray(cra),
+        refine_mapper=refine_mapper,
+        refine_alphas=refine_alphas,
+        replace_mapper=replace_mapper,
+        equalizer=equalizer,
+        blend=blend,
+        kind=kind,
+        num_prompts=n_prompts,
+        self_replace_range=srr,
+    )
+
+
+# --------------------------------------------------------------------- #
+# edit functions (operate on the conditional half)
+# --------------------------------------------------------------------- #
+
+
+def _edit_cross(probs: jax.Array, ctx: ControlContext, step_index: jax.Array) -> jax.Array:
+    """probs: (P, F, H, Q, W) conditional-half cross-attention probabilities."""
+    base, repl = probs[0], probs[1:]  # (F,H,Q,W), (E,F,H,Q,W)
+
+    if ctx.kind == "replace":
+        new = jnp.einsum("fhqw,ewn->efhqn", base, ctx.replace_mapper)
+    elif ctx.kind == "refine":
+        gathered = jax.vmap(lambda m: jnp.take(base, m, axis=-1))(ctx.refine_mapper)
+        al = ctx.refine_alphas[:, None, None, None, :]
+        new = gathered * al + repl * (1.0 - al)
+    else:
+        raise ValueError(f"unknown cross edit kind: {ctx.kind!r}")
+
+    if ctx.equalizer is not None:
+        new = new * ctx.equalizer[:, None, None, None, :]
+
+    # time gate: (E, 1, 1, W) → (E, 1, 1, 1, W)
+    alpha_words = ctx.cross_replace_alpha[step_index][:, :, :, None, :]
+    out = new * alpha_words + (1.0 - alpha_words) * repl
+    return jnp.concatenate([base[None], out], axis=0)
+
+
+def _edit_temporal(probs: jax.Array, ctx: ControlContext, step_index: jax.Array) -> jax.Array:
+    """probs: (P, D, H, F, F) conditional-half temporal attention probabilities.
+
+    Frame counts are always ≤ 32² so the reference's query-size guard
+    (run_videop2p.py:294) is unconditionally true.
+    """
+    lo, hi = ctx.self_replace_range
+    active = jnp.logical_and(step_index >= lo, step_index < hi)
+    base, repl = probs[0], probs[1:]
+    broadcast = jnp.broadcast_to(base[None], repl.shape)
+    out = jnp.where(active, broadcast, repl)
+    return jnp.concatenate([base[None], out], axis=0)
+
+
+def control_attention(
+    probs: jax.Array,
+    ctx: Optional[ControlContext],
+    *,
+    is_cross: bool,
+    step_index: jax.Array,
+    video_length: int,
+) -> jax.Array:
+    """Apply the edit to full-batch attention probabilities.
+
+    Layouts (uncond streams first, matching the CFG batch of
+    pipeline_tuneavideo.py:235):
+      cross:    (2·P·F, H, Q, W)  — frames folded into batch
+      temporal: (2·P·D, H, F, F)  — spatial positions folded into batch
+    Only the conditional half is edited (run_videop2p.py:217-218).
+    """
+    if ctx is None:
+        return probs
+    P = ctx.num_prompts
+    B, H, Q, K = probs.shape
+    inner = B // (2 * P)  # F for cross sites, D (=h·w) for temporal sites
+    if is_cross and inner != video_length:
+        raise ValueError(
+            f"cross-attention batch {B} does not factor as 2·{P}·{video_length} "
+            "(uncond+cond × prompts × frames) — batch layout mismatch"
+        )
+    if not is_cross and (Q != video_length or K != video_length):
+        raise ValueError(
+            f"temporal attention maps must be ({video_length}×{video_length}), got ({Q}×{K})"
+        )
+
+    split = probs.reshape(2, P, inner, H, Q, K)
+    cond = split[1]
+    if is_cross:
+        edited = _edit_cross(cond, ctx, step_index)
+    else:
+        # temporal layout folds spatial positions; move them next to heads
+        edited = _edit_temporal(cond, ctx, step_index)
+    out = jnp.stack([split[0], edited], axis=0)
+    return out.reshape(B, H, Q, K)

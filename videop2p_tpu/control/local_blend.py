@@ -1,0 +1,135 @@
+"""LocalBlend: word-localized latent blending from stored cross-attention.
+
+Functional re-design of the reference LocalBlend (run_videop2p.py:129-181):
+per-frame spatial masks are derived from the running sum of the 16×16-res
+cross-attention maps (the reference's `down_cross[2:4] + up_cross[:3]` sites,
+run_videop2p.py:145), thresholded, unioned with the source-stream mask, and
+used to pull the edited latents back toward the source outside the masked
+region. The reference hard-codes 8 frames and 16×16 (run_videop2p.py:146);
+here both are parametric.
+
+The map accumulator lives in the sampling scan's carry (the reference keeps it
+in the controller's mutable `attention_store`, summed across steps in
+`between_steps`, run_videop2p.py:261-268 — scale-invariant here because the
+mask is max-normalized before thresholding).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from videop2p_tpu.control.schedules import get_word_inds
+from videop2p_tpu.utils.tokenizers import MAX_NUM_WORDS, Tokenizer
+
+__all__ = ["LocalBlendConfig", "make_local_blend", "local_blend"]
+
+
+class LocalBlendConfig(struct.PyTreeNode):
+    alpha_layers: jax.Array  # (P, 1, 77) word mask per prompt stream
+    substruct_layers: Optional[jax.Array] = None  # (P, 1, 77)
+    start_blend: int = struct.field(pytree_node=False, default=10)
+    th: Tuple[float, float] = struct.field(pytree_node=False, default=(0.3, 0.3))
+
+
+def _word_alpha_layers(
+    prompts: Sequence[str], words_per_prompt, tokenizer: Tokenizer
+) -> np.ndarray:
+    layers = np.zeros((len(prompts), 1, MAX_NUM_WORDS), dtype=np.float32)
+    for i, (prompt, words) in enumerate(zip(prompts, words_per_prompt)):
+        if isinstance(words, str):
+            words = [words]
+        for word in words:
+            inds = get_word_inds(prompt, word, tokenizer)
+            layers[i, :, inds] = 1.0
+    return layers
+
+
+def make_local_blend(
+    prompts: Sequence[str],
+    words: Tuple[Sequence[str], Sequence[str]],
+    tokenizer: Tokenizer,
+    num_steps: int,
+    *,
+    substruct_words=None,
+    start_blend: float = 0.2,
+    th: Tuple[float, float] = (0.3, 0.3),
+) -> LocalBlendConfig:
+    """Build the blend config (run_videop2p.py:157-180)."""
+    alpha_layers = jnp.asarray(_word_alpha_layers(prompts, words, tokenizer))
+    substruct = None
+    if substruct_words is not None:
+        substruct = jnp.asarray(_word_alpha_layers(prompts, substruct_words, tokenizer))
+    return LocalBlendConfig(
+        alpha_layers=alpha_layers,
+        substruct_layers=substruct,
+        start_blend=int(start_blend * num_steps),
+        th=th,
+    )
+
+
+def _max_pool_3x3(x: jax.Array) -> jax.Array:
+    """3×3 stride-1 same-padded max pool over the last two axes
+    (k=1 in run_videop2p.py:132-135)."""
+    return jax.lax.reduce_window(
+        x,
+        -jnp.inf,
+        jax.lax.max,
+        window_dimensions=(1,) * (x.ndim - 2) + (3, 3),
+        window_strides=(1,) * x.ndim,
+        padding=[(0, 0)] * (x.ndim - 2) + [(1, 1), (1, 1)],
+    )
+
+
+def _get_mask(
+    maps: jax.Array,
+    word_layers: jax.Array,
+    use_pool: bool,
+    out_hw: Tuple[int, int],
+    th: Tuple[float, float],
+) -> jax.Array:
+    """Boolean (P, F, h, w) mask from accumulated maps
+    (run_videop2p.py:131-140).
+
+    ``maps``: (P, F, S, r, r, 77) — S stacks the contributing sites (head-mean;
+    head-averaging commutes with the word-sum + site-mean the reference takes
+    over its concatenated per-head maps).
+    """
+    sel = (maps * word_layers[:, None, None, None, None, :]).sum(-1).mean(2)  # (P,F,r,r)
+    if use_pool:
+        sel = _max_pool_3x3(sel)
+    P, F = sel.shape[:2]
+    mask = jax.image.resize(sel, (P, F) + tuple(out_hw), method="nearest")
+    mask = mask / (mask.max(axis=(-2, -1), keepdims=True) + 1e-20)
+    mask = mask > th[1 - int(use_pool)]
+    mask = jnp.logical_or(mask[:1], mask)  # union with the source-stream mask
+    return mask
+
+
+def local_blend(
+    x_t: jax.Array,
+    maps: jax.Array,
+    cfg: LocalBlendConfig,
+    step_index: jax.Array,
+) -> jax.Array:
+    """Blend edited latents toward the source outside the word mask
+    (run_videop2p.py:142-155).
+
+    ``x_t``: (P, F, h, w, C) latents (source stream first);
+    ``maps``: (P, F, S, r, r, 77) running-sum cross-attention maps.
+    Active once ``step_index >= start_blend`` (the reference's counter>start
+    gate, run_videop2p.py:143-144).
+    """
+    out_hw = x_t.shape[2:4]
+    mask = _get_mask(maps, cfg.alpha_layers[:, 0, :], True, out_hw, cfg.th)
+    if cfg.substruct_layers is not None:
+        sub = _get_mask(maps, cfg.substruct_layers[:, 0, :], False, out_hw, cfg.th)
+        mask = jnp.logical_and(mask, jnp.logical_not(sub))
+    maskf = mask.astype(x_t.dtype)[..., None]  # (P,F,h,w,1)
+    blended = x_t[:1] + maskf * (x_t - x_t[:1])
+    active = step_index >= cfg.start_blend
+    return jnp.where(active, blended, x_t)

@@ -1,0 +1,7 @@
+"""Core diffusion math: schedulers and noise processes (pure JAX)."""
+
+from videop2p_tpu.core.ddim import DDIMScheduler
+from videop2p_tpu.core.ddpm import DDPMScheduler
+from videop2p_tpu.core.noise import DependentNoiseSampler
+
+__all__ = ["DDIMScheduler", "DDPMScheduler", "DependentNoiseSampler"]

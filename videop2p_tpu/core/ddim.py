@@ -1,0 +1,294 @@
+"""Functional DDIM scheduler with a dependent-noise seam.
+
+TPU-native re-design of the reference's ``DDIMScheduler_dependent``
+(/root/reference/dependent_ddim.py:78-388). Differences from the reference:
+
+  * the scheduler is an immutable pytree (`flax.struct.PyTreeNode`) — ``step``
+    is a pure function safe inside ``jax.jit`` / ``lax.scan`` with traced
+    timesteps;
+  * instead of the scheduler *calling* a stateful sampler for η-variance noise
+    (dependent_ddim.py:320-334), callers pass ``variance_noise`` explicitly
+    (drawn i.i.d. or from :class:`~videop2p_tpu.core.noise.DependentNoiseSampler`)
+    so randomness stays key-threaded and the step stays pure;
+  * closed-form inversion steps (``next_step`` / ``prev_step``, mirroring
+    /root/reference/run_videop2p.py:445-463) live on the scheduler itself.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+__all__ = ["DDIMScheduler", "make_beta_schedule"]
+
+
+def make_beta_schedule(
+    schedule: str,
+    num_train_timesteps: int,
+    beta_start: float,
+    beta_end: float,
+    *,
+    max_beta: float = 0.999,
+) -> np.ndarray:
+    """β schedule, matching dependent_ddim.py:141-154 semantics.
+
+    ``scaled_linear`` is linear in sqrt-space (the Stable Diffusion schedule);
+    ``squaredcos_cap_v2`` is the Nichol/Dhariwal cosine ᾱ schedule
+    (dependent_ddim.py:49-75).
+    """
+    if schedule == "linear":
+        betas = np.linspace(beta_start, beta_end, num_train_timesteps, dtype=np.float64)
+    elif schedule == "scaled_linear":
+        betas = (
+            np.linspace(beta_start**0.5, beta_end**0.5, num_train_timesteps, dtype=np.float64)
+            ** 2
+        )
+    elif schedule == "squaredcos_cap_v2":
+        def alpha_bar(t: np.ndarray) -> np.ndarray:
+            return np.cos((t + 0.008) / 1.008 * np.pi / 2) ** 2
+
+        t1 = np.arange(num_train_timesteps, dtype=np.float64) / num_train_timesteps
+        t2 = (np.arange(num_train_timesteps, dtype=np.float64) + 1) / num_train_timesteps
+        betas = np.minimum(1.0 - alpha_bar(t2) / alpha_bar(t1), max_beta)
+    else:
+        raise ValueError(f"unknown beta schedule: {schedule!r}")
+    return betas.astype(np.float32)
+
+
+class DDIMScheduler(struct.PyTreeNode):
+    """Immutable DDIM scheduler state.
+
+    Array leaves participate in jit tracing; config fields are static.
+    """
+
+    alphas_cumprod: jax.Array  # (num_train_timesteps,) float32
+    final_alpha_cumprod: jax.Array  # () float32
+
+    num_train_timesteps: int = struct.field(pytree_node=False, default=1000)
+    beta_start: float = struct.field(pytree_node=False, default=0.0001)
+    beta_end: float = struct.field(pytree_node=False, default=0.02)
+    beta_schedule: str = struct.field(pytree_node=False, default="linear")
+    clip_sample: bool = struct.field(pytree_node=False, default=True)
+    set_alpha_to_one: bool = struct.field(pytree_node=False, default=True)
+    steps_offset: int = struct.field(pytree_node=False, default=0)
+    prediction_type: str = struct.field(pytree_node=False, default="epsilon")
+
+    @classmethod
+    def create(
+        cls,
+        num_train_timesteps: int = 1000,
+        beta_start: float = 0.0001,
+        beta_end: float = 0.02,
+        beta_schedule: str = "linear",
+        clip_sample: bool = True,
+        set_alpha_to_one: bool = True,
+        steps_offset: int = 0,
+        prediction_type: str = "epsilon",
+    ) -> "DDIMScheduler":
+        betas = make_beta_schedule(beta_schedule, num_train_timesteps, beta_start, beta_end)
+        alphas_cumprod = np.cumprod(1.0 - betas).astype(np.float32)
+        # At the t=0 boundary DDIM steps to ᾱ = 1 ("clean") or ᾱ_0
+        # (dependent_ddim.py:156-166).
+        final = 1.0 if set_alpha_to_one else float(alphas_cumprod[0])
+        return cls(
+            alphas_cumprod=jnp.asarray(alphas_cumprod),
+            final_alpha_cumprod=jnp.asarray(final, dtype=jnp.float32),
+            num_train_timesteps=num_train_timesteps,
+            beta_start=beta_start,
+            beta_end=beta_end,
+            beta_schedule=beta_schedule,
+            clip_sample=clip_sample,
+            set_alpha_to_one=set_alpha_to_one,
+            steps_offset=steps_offset,
+            prediction_type=prediction_type,
+        )
+
+    @classmethod
+    def create_sd(cls, **overrides) -> "DDIMScheduler":
+        """The Stable-Diffusion configuration used throughout the reference
+        (run_videop2p.py:30)."""
+        cfg = dict(
+            beta_start=0.00085,
+            beta_end=0.012,
+            beta_schedule="scaled_linear",
+            clip_sample=False,
+            set_alpha_to_one=False,
+        )
+        cfg.update(overrides)
+        return cls.create(**cfg)
+
+    # ------------------------------------------------------------------ #
+    # timestep grid
+    # ------------------------------------------------------------------ #
+
+    def timesteps(self, num_inference_steps: int) -> np.ndarray:
+        """Descending inference timesteps (dependent_ddim.py:196-210).
+
+        Static (numpy) because the grid shapes the scan; values feed the jitted
+        step as a traced operand.
+        """
+        step_ratio = self.num_train_timesteps // num_inference_steps
+        ts = (np.arange(num_inference_steps) * step_ratio).round()[::-1].astype(np.int64)
+        return ts + self.steps_offset
+
+    # ------------------------------------------------------------------ #
+    # shared math
+    # ------------------------------------------------------------------ #
+
+    def _alpha_prod(self, timestep: jax.Array) -> jax.Array:
+        """ᾱ_t with the t<0 → final_alpha_cumprod boundary handled for traced t."""
+        t = jnp.asarray(timestep)
+        safe_t = jnp.clip(t, 0, self.num_train_timesteps - 1)
+        return jnp.where(t >= 0, self.alphas_cumprod[safe_t], self.final_alpha_cumprod)
+
+    def predict_x0_eps(
+        self, model_output: jax.Array, timestep: jax.Array, sample: jax.Array
+    ) -> Tuple[jax.Array, jax.Array]:
+        """(pred_x0, pred_eps) under the configured prediction type
+        (dependent_ddim.py:278-290)."""
+        alpha_prod_t = self._alpha_prod(timestep)
+        beta_prod_t = 1.0 - alpha_prod_t
+        a, b = jnp.sqrt(alpha_prod_t), jnp.sqrt(beta_prod_t)
+        if self.prediction_type == "epsilon":
+            pred_x0 = (sample - b * model_output) / a
+            pred_eps = model_output
+        elif self.prediction_type == "sample":
+            pred_x0 = model_output
+            pred_eps = (sample - a * pred_x0) / b
+        elif self.prediction_type == "v_prediction":
+            pred_x0 = a * sample - b * model_output
+            pred_eps = a * model_output + b * sample
+        else:
+            raise ValueError(f"unknown prediction_type: {self.prediction_type!r}")
+        return pred_x0, pred_eps
+
+    def variance(self, timestep: jax.Array, prev_timestep: jax.Array) -> jax.Array:
+        """σ_t² pre-η (dependent_ddim.py:184-194)."""
+        alpha_prod_t = self._alpha_prod(timestep)
+        alpha_prod_t_prev = self._alpha_prod(prev_timestep)
+        beta_prod_t = 1.0 - alpha_prod_t
+        beta_prod_t_prev = 1.0 - alpha_prod_t_prev
+        return (beta_prod_t_prev / beta_prod_t) * (1.0 - alpha_prod_t / alpha_prod_t_prev)
+
+    # ------------------------------------------------------------------ #
+    # reverse (denoise) step
+    # ------------------------------------------------------------------ #
+
+    def step(
+        self,
+        model_output: jax.Array,
+        timestep: jax.Array,
+        sample: jax.Array,
+        num_inference_steps: int,
+        *,
+        eta: float = 0.0,
+        variance_noise: Optional[jax.Array] = None,
+        use_clipped_model_output: bool = False,
+    ) -> Tuple[jax.Array, jax.Array]:
+        """One reverse DDIM step x_t → x_{t-Δ} (dependent_ddim.py:212-341).
+
+        Returns ``(prev_sample, pred_original_sample)``. When ``eta > 0`` the
+        caller must supply ``variance_noise`` (i.i.d. normal or a draw from the
+        dependent sampler — the reference's ``dependent=True`` path,
+        dependent_ddim.py:320-334).
+        """
+        prev_timestep = timestep - self.num_train_timesteps // num_inference_steps
+
+        alpha_prod_t = self._alpha_prod(timestep)
+        alpha_prod_t_prev = self._alpha_prod(prev_timestep)
+        beta_prod_t = 1.0 - alpha_prod_t
+
+        pred_x0, pred_eps = self.predict_x0_eps(model_output, timestep, sample)
+        if self.clip_sample:
+            pred_x0 = jnp.clip(pred_x0, -1.0, 1.0)
+
+        var = self.variance(timestep, prev_timestep)
+        std_dev_t = eta * jnp.sqrt(var)
+
+        if use_clipped_model_output:
+            pred_eps = (sample - jnp.sqrt(alpha_prod_t) * pred_x0) / jnp.sqrt(beta_prod_t)
+
+        pred_sample_direction = jnp.sqrt(1.0 - alpha_prod_t_prev - std_dev_t**2) * pred_eps
+        prev_sample = jnp.sqrt(alpha_prod_t_prev) * pred_x0 + pred_sample_direction
+
+        if eta > 0:
+            if variance_noise is None:
+                raise ValueError("eta > 0 requires variance_noise (key-threaded by caller)")
+            prev_sample = prev_sample + std_dev_t * variance_noise
+
+        return prev_sample, pred_x0
+
+    # ------------------------------------------------------------------ #
+    # closed-form inversion steps (NullInversion.prev_step/next_step,
+    # run_videop2p.py:445-463)
+    # ------------------------------------------------------------------ #
+
+    def prev_step(
+        self,
+        model_output: jax.Array,
+        timestep: jax.Array,
+        sample: jax.Array,
+        num_inference_steps: int,
+    ) -> jax.Array:
+        """Deterministic (η=0, no clipping) x_t → x_{t-Δ}; the form used inside
+        null-text optimization (run_videop2p.py:445-453)."""
+        prev_timestep = timestep - self.num_train_timesteps // num_inference_steps
+        alpha_prod_t = self._alpha_prod(timestep)
+        alpha_prod_t_prev = self._alpha_prod(prev_timestep)
+        beta_prod_t = 1.0 - alpha_prod_t
+        pred_x0 = (sample - jnp.sqrt(beta_prod_t) * model_output) / jnp.sqrt(alpha_prod_t)
+        direction = jnp.sqrt(1.0 - alpha_prod_t_prev) * model_output
+        return jnp.sqrt(alpha_prod_t_prev) * pred_x0 + direction
+
+    def next_step(
+        self,
+        model_output: jax.Array,
+        timestep: jax.Array,
+        sample: jax.Array,
+        num_inference_steps: int,
+    ) -> jax.Array:
+        """Forward DDIM (inversion) x_{t-Δ} → x_t (run_videop2p.py:455-463)."""
+        next_timestep = timestep
+        cur_timestep = jnp.minimum(
+            next_timestep - self.num_train_timesteps // num_inference_steps,
+            self.num_train_timesteps - 1,
+        )
+        alpha_prod_t = self._alpha_prod(cur_timestep)
+        alpha_prod_t_next = self._alpha_prod(next_timestep)
+        beta_prod_t = 1.0 - alpha_prod_t
+        next_x0 = (sample - jnp.sqrt(beta_prod_t) * model_output) / jnp.sqrt(alpha_prod_t)
+        direction = jnp.sqrt(1.0 - alpha_prod_t_next) * model_output
+        return jnp.sqrt(alpha_prod_t_next) * next_x0 + direction
+
+    # ------------------------------------------------------------------ #
+    # forward process
+    # ------------------------------------------------------------------ #
+
+    def add_noise(
+        self, original_samples: jax.Array, noise: jax.Array, timesteps: jax.Array
+    ) -> jax.Array:
+        """q(x_t | x_0) sampling (dependent_ddim.py:343-365)."""
+        alpha_prod = self.alphas_cumprod[timesteps]
+        shape = alpha_prod.shape + (1,) * (original_samples.ndim - alpha_prod.ndim)
+        a = jnp.sqrt(alpha_prod).reshape(shape)
+        b = jnp.sqrt(1.0 - alpha_prod).reshape(shape)
+        return a * original_samples + b * noise
+
+    def get_velocity(
+        self, sample: jax.Array, noise: jax.Array, timesteps: jax.Array
+    ) -> jax.Array:
+        """v-prediction target (dependent_ddim.py:367-385)."""
+        alpha_prod = self.alphas_cumprod[timesteps]
+        shape = alpha_prod.shape + (1,) * (sample.ndim - alpha_prod.ndim)
+        a = jnp.sqrt(alpha_prod).reshape(shape)
+        b = jnp.sqrt(1.0 - alpha_prod).reshape(shape)
+        return a * noise - b * sample
+
+    @property
+    def init_noise_sigma(self) -> float:
+        """Initial latent scale (DDIM: 1.0; pipeline_tuneavideo.py:318)."""
+        return 1.0

@@ -1,0 +1,77 @@
+"""Minimal functional DDPM scheduler for Stage-1 training.
+
+The reference consumes ``diffusers.DDPMScheduler`` only for the forward
+process during tuning (`add_noise`, run_tuning.py:127,304) and as the training
+target oracle (ε / v, run_tuning.py:310-315). This provides exactly that
+surface, sharing the β-schedule math with :mod:`videop2p_tpu.core.ddim`.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from videop2p_tpu.core.ddim import make_beta_schedule
+
+__all__ = ["DDPMScheduler"]
+
+
+class DDPMScheduler(struct.PyTreeNode):
+    alphas_cumprod: jax.Array  # (num_train_timesteps,) float32
+
+    num_train_timesteps: int = struct.field(pytree_node=False, default=1000)
+    beta_schedule: str = struct.field(pytree_node=False, default="linear")
+    prediction_type: str = struct.field(pytree_node=False, default="epsilon")
+
+    @classmethod
+    def create(
+        cls,
+        num_train_timesteps: int = 1000,
+        beta_start: float = 0.0001,
+        beta_end: float = 0.02,
+        beta_schedule: str = "linear",
+        prediction_type: str = "epsilon",
+    ) -> "DDPMScheduler":
+        betas = make_beta_schedule(beta_schedule, num_train_timesteps, beta_start, beta_end)
+        return cls(
+            alphas_cumprod=jnp.asarray(np.cumprod(1.0 - betas).astype(np.float32)),
+            num_train_timesteps=num_train_timesteps,
+            beta_schedule=beta_schedule,
+            prediction_type=prediction_type,
+        )
+
+    @classmethod
+    def create_sd(cls, **overrides) -> "DDPMScheduler":
+        """SD-1.x training schedule (the `scheduler/` subfolder the reference
+        loads at run_tuning.py:127)."""
+        cfg = dict(beta_start=0.00085, beta_end=0.012, beta_schedule="scaled_linear")
+        cfg.update(overrides)
+        return cls.create(**cfg)
+
+    def _coeffs(self, timesteps: jax.Array, ndim: int):
+        alpha_prod = self.alphas_cumprod[timesteps]
+        shape = alpha_prod.shape + (1,) * (ndim - alpha_prod.ndim)
+        return jnp.sqrt(alpha_prod).reshape(shape), jnp.sqrt(1.0 - alpha_prod).reshape(shape)
+
+    def add_noise(
+        self, original_samples: jax.Array, noise: jax.Array, timesteps: jax.Array
+    ) -> jax.Array:
+        a, b = self._coeffs(timesteps, original_samples.ndim)
+        return a * original_samples + b * noise
+
+    def get_velocity(self, sample: jax.Array, noise: jax.Array, timesteps: jax.Array) -> jax.Array:
+        a, b = self._coeffs(timesteps, sample.ndim)
+        return a * noise - b * sample
+
+    def training_target(
+        self, sample: jax.Array, noise: jax.Array, timesteps: jax.Array
+    ) -> jax.Array:
+        """The regression target for the configured prediction type
+        (run_tuning.py:310-315)."""
+        if self.prediction_type == "epsilon":
+            return noise
+        if self.prediction_type == "v_prediction":
+            return self.get_velocity(sample, noise, timesteps)
+        raise ValueError(f"unknown prediction_type: {self.prediction_type!r}")

@@ -1,0 +1,1 @@
+"""Utilities: tokenizers, layout converters, config, video IO."""

@@ -1,0 +1,139 @@
+"""Tokenizer protocol + implementations.
+
+The P2P control layer needs only a narrow tokenizer surface (encode to ids,
+decode single tokens back to text pieces — cf. the reference's use of
+``CLIPTokenizer`` in ptp_utils.py:258-276 and seq_aligner.py:109-120):
+
+  * :class:`CLIPTokenizerWrapper` loads a real CLIP BPE tokenizer from a local
+    diffusers checkpoint dir (``tokenizer/`` subfolder) via ``transformers`` —
+    used when SD-1.x weights are on disk.
+  * :class:`WordTokenizer` is a deterministic, dependency-free word-level
+    tokenizer with CLIP-compatible special ids — used in tests and smoke runs
+    where no vocab files exist. Alignment/mapper logic is tokenizer-agnostic.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+from typing import List, Protocol
+
+__all__ = ["Tokenizer", "WordTokenizer", "CLIPTokenizerWrapper", "MAX_NUM_WORDS"]
+
+# CLIP context length; the reference's MAX_NUM_WORDS (run_videop2p.py:36).
+MAX_NUM_WORDS = 77
+
+
+class Tokenizer(Protocol):
+    model_max_length: int
+    bos_token_id: int
+    eos_token_id: int
+
+    def encode(self, text: str) -> List[int]:
+        """Token ids including BOS/EOS (no padding)."""
+        ...
+
+    def decode_token(self, token_id: int) -> str:
+        """Text piece for a single id (word-boundary markers stripped)."""
+        ...
+
+    def encode_padded(self, text: str) -> List[int]:
+        """Fixed-length (model_max_length) ids, EOS-padded — the CLIP
+        'max_length' padding convention."""
+        ...
+
+
+class _Base:
+    model_max_length = MAX_NUM_WORDS
+
+    def encode_padded(self, text: str) -> List[int]:
+        ids = self.encode(text)
+        if len(ids) > self.model_max_length:
+            # CLIP truncation keeps EOS as the final token (the pooled
+            # embedding is taken at EOS)
+            ids = ids[: self.model_max_length - 1] + [self.eos_token_id]
+        pad = [self.eos_token_id] * (self.model_max_length - len(ids))
+        return ids + pad
+
+
+class WordTokenizer(_Base):
+    """Deterministic word-level tokenizer.
+
+    Each lowercase word hashes to a stable id in [0, vocab_size); BOS/EOS use
+    the CLIP ids (49406/49407). ``decode_token`` uses a reverse memo populated
+    on encode, which covers every id the control layer will ever decode
+    (get_word_inds only decodes ids from its own encode, ptp_utils.py:266).
+    """
+
+    def __init__(self, vocab_size: int = 49408):
+        self.vocab_size = vocab_size
+        self.bos_token_id = vocab_size - 2
+        self.eos_token_id = vocab_size - 1
+        self._reverse = {self.bos_token_id: "<|startoftext|>", self.eos_token_id: "<|endoftext|>"}
+
+    def _word_id(self, word: str) -> int:
+        h = hashlib.sha1(word.encode("utf-8")).digest()
+        wid = int.from_bytes(h[:4], "little") % (self.vocab_size - 2)
+        return wid
+
+    def tokenize_words(self, text: str) -> List[str]:
+        return [w for w in re.split(r"\s+", text.strip().lower()) if w]
+
+    def encode(self, text: str) -> List[int]:
+        ids = [self.bos_token_id]
+        # truncate like CLIP: at most max_length ids with EOS kept last
+        for w in self.tokenize_words(text)[: self.model_max_length - 2]:
+            wid = self._word_id(w)
+            # linear probe on (vanishingly unlikely) hash collision
+            while wid in self._reverse and self._reverse[wid] != w:
+                wid = (wid + 1) % (self.vocab_size - 2)
+            self._reverse[wid] = w
+            ids.append(wid)
+        ids.append(self.eos_token_id)
+        return ids
+
+    def decode_token(self, token_id: int) -> str:
+        return self._reverse.get(int(token_id), "")
+
+
+class CLIPTokenizerWrapper(_Base):
+    """Real CLIP BPE tokenizer loaded from a local checkpoint directory."""
+
+    def __init__(self, path: str):
+        from transformers import CLIPTokenizer  # local import: optional dep path
+
+        self._tok = CLIPTokenizer.from_pretrained(path)
+        self.model_max_length = int(self._tok.model_max_length)
+        self.bos_token_id = int(self._tok.bos_token_id)
+        self.eos_token_id = int(self._tok.eos_token_id)
+
+    def encode(self, text: str) -> List[int]:
+        return list(self._tok.encode(text))
+
+    def decode_token(self, token_id: int) -> str:
+        # the reference strips '#' continuation markers (ptp_utils.py:266);
+        # CLIP BPE marks word ends with '</w>' which .decode already drops.
+        return self._tok.decode([int(token_id)]).strip("#")
+
+
+def load_tokenizer(checkpoint_path: str | None) -> Tokenizer:
+    """CLIP tokenizer from ``<ckpt>/tokenizer`` when present, else the
+    dependency-free word tokenizer."""
+    if checkpoint_path is not None:
+        import os
+
+        tok_dir = os.path.join(checkpoint_path, "tokenizer")
+        if os.path.isdir(tok_dir):
+            try:
+                return CLIPTokenizerWrapper(tok_dir)
+            except Exception as exc:  # pragma: no cover - env-dependent
+                import warnings
+
+                warnings.warn(
+                    f"failed to load CLIP tokenizer from {tok_dir!r} ({exc!r}); "
+                    "falling back to WordTokenizer — token ids will NOT match "
+                    "a real CLIP text encoder, so word-level edits may target "
+                    "the wrong tokens",
+                    stacklevel=2,
+                )
+    return WordTokenizer()
