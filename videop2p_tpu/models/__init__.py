@@ -1,0 +1,34 @@
+"""Model zoo: the video UNet and its building blocks (flax linen)."""
+
+from videop2p_tpu.models.attention import (
+    AttnControl,
+    BasicTransformerBlock,
+    ControlledAttention,
+    FrameAttention,
+    Transformer3DModel,
+)
+from videop2p_tpu.models.layers import (
+    Downsample3D,
+    InflatedConv,
+    ResnetBlock3D,
+    TimestepEmbedding,
+    Upsample3D,
+    get_timestep_embedding,
+)
+from videop2p_tpu.models.unet import UNet3DConditionModel, UNet3DConfig
+
+__all__ = [
+    "AttnControl",
+    "BasicTransformerBlock",
+    "ControlledAttention",
+    "FrameAttention",
+    "Transformer3DModel",
+    "Downsample3D",
+    "InflatedConv",
+    "ResnetBlock3D",
+    "TimestepEmbedding",
+    "Upsample3D",
+    "get_timestep_embedding",
+    "UNet3DConditionModel",
+    "UNet3DConfig",
+]

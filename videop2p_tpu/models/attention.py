@@ -1,0 +1,309 @@
+"""Video transformer block: frame (spatial), text-cross and temporal attention.
+
+TPU-native re-design of /root/reference/tuneavideo/models/attention.py. Key
+behaviors preserved:
+
+  * ``attn1`` is **FrameAttention** — spatial self-attention where every
+    frame's keys/values come from frame 0 only (attention.py:296-302). This is
+    the big hw×hw attention; it is NOT a controlled site (the reference's
+    monkey-patch only rebinds modules named ``CrossAttention``,
+    ptp_utils.py:236-239).
+  * ``attn2`` is text cross-attention — a controlled site (``is_cross=True``).
+  * ``attn_temp`` is temporal self-attention over the frame axis with a
+    **zero-initialized output projection** (attention.py:196-202) so the
+    2-D→3-D inflation starts as the identity — a controlled site
+    (``is_cross=False``; see SURVEY §3.4 subtlety 1).
+
+Control is a pure function applied to materialized attention probabilities
+(:func:`videop2p_tpu.control.control_attention`) instead of a monkey-patched
+forward; sites also ``sow`` head-averaged probability maps into the
+``attn_store`` collection (the reference's ``AttentionStore``,
+run_videop2p.py:248-284) when the caller makes that collection mutable.
+
+Batch layout matches the reference's fold order so the control layer can
+factor the batch axis: frames fold batch-major ``(B, F, …) → (B·F, …)`` for
+spatial/cross sites, spatial positions fold batch-major ``(B·N, F, C)`` for
+the temporal site (attention.py:94, :262-268).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from flax import struct
+
+from videop2p_tpu.control.controllers import ControlContext, control_attention
+
+__all__ = [
+    "AttnControl",
+    "FrameAttention",
+    "ControlledAttention",
+    "FeedForward",
+    "BasicTransformerBlock",
+    "Transformer3DModel",
+]
+
+Dtype = jnp.dtype
+
+
+class AttnControl(struct.PyTreeNode):
+    """Bundle threaded through the UNet forward: the edit context plus the
+    (traced) step index of the enclosing sampling scan. Replaces the
+    reference's hidden ``cur_step``/``cur_att_layer`` counters
+    (run_videop2p.py:212-224)."""
+
+    ctx: ControlContext
+    step_index: jax.Array  # () int32
+
+
+def _split_heads(x: jax.Array, heads: int) -> jax.Array:
+    """(B, N, H·D) → (B, H, N, D)"""
+    b, n, _ = x.shape
+    return x.reshape(b, n, heads, -1).transpose(0, 2, 1, 3)
+
+
+def _merge_heads(x: jax.Array) -> jax.Array:
+    """(B, H, N, D) → (B, N, H·D)"""
+    b, h, n, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b, n, h * d)
+
+
+def _stable_softmax(sim: jax.Array, dtype: Dtype) -> jax.Array:
+    """Softmax in float32 regardless of compute dtype (the reference's
+    exp(sim−max)/Σ stabilization, ptp_utils.py:217)."""
+    return jax.nn.softmax(sim.astype(jnp.float32), axis=-1).astype(dtype)
+
+
+class FrameAttention(nn.Module):
+    """Spatial self-attention with frame-0 keys/values
+    (reference ``FrameAttention``, attention.py:239-328).
+
+    Input: (B, F, N, C) with N = H·W spatial positions. Queries come from
+    every frame; keys/values from frame 0 only — O(F·N²) with a shared KV,
+    which on TPU is one batched MXU matmul per projection. The computed
+    ``former_frame_index`` in the reference is dead code (attention.py:293-294);
+    Video-P2P uses first-frame attention, not sparse-causal [first, former].
+
+    ``attention_fn`` lets callers swap the inner softmax-attention for a
+    fused Pallas flash kernel (ops.flash_attention); signature
+    ``(q, k, v) -> out`` with shapes (B, F, H, N, D), (B, H, N, D) ×2.
+    """
+
+    heads: int
+    dim_head: int
+    dtype: Dtype = jnp.float32
+    attention_fn: Optional[Callable[[jax.Array, jax.Array, jax.Array], jax.Array]] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        b, f, n, _ = x.shape
+        inner = self.heads * self.dim_head
+        q = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_q")(x)
+        kv_src = x[:, 0]  # frame-0 KV (attention.py:296-302)
+        k = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_k")(kv_src)
+        v = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_v")(kv_src)
+
+        q = q.reshape(b, f, n, self.heads, self.dim_head).transpose(0, 1, 3, 2, 4)
+        k = _split_heads(k, self.heads)
+        v = _split_heads(v, self.heads)
+
+        if self.attention_fn is not None:
+            out = self.attention_fn(q, k, v)
+        else:
+            scale = self.dim_head ** -0.5
+            sim = jnp.einsum("bfhqd,bhkd->bfhqk", q, k) * scale
+            probs = _stable_softmax(sim, self.dtype)
+            out = jnp.einsum("bfhqk,bhkd->bfhqd", probs, v)
+
+        out = out.transpose(0, 1, 3, 2, 4).reshape(b, f, n, inner)
+        return nn.Dense(inner, dtype=self.dtype, name="to_out")(out)
+
+
+class ControlledAttention(nn.Module):
+    """Multi-head attention with materialized, editable probabilities.
+
+    ``site`` is ``"cross"`` (text cross-attention) or ``"temporal"`` (frame
+    self-attention). Probabilities are (B, H, Q, K); when an
+    :class:`AttnControl` is supplied they pass through the pure edit
+    ``control_attention`` (the reference's patched ``attn =
+    controller(attn, …)`` seam, ptp_utils.py:218); head-averaged pre-edit maps
+    are sown into the ``attn_store`` collection when Q ≤ 32² (the reference's
+    store guard, run_videop2p.py:257).
+    """
+
+    heads: int
+    dim_head: int
+    site: str  # "cross" | "temporal"
+    zero_init_out: bool = False
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jax.Array,
+        context: Optional[jax.Array] = None,
+        control: Optional[AttnControl] = None,
+        video_length: Optional[int] = None,
+    ) -> jax.Array:
+        inner = self.heads * self.dim_head
+        ctx_in = x if context is None else context
+
+        q = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_q")(x)
+        k = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_k")(ctx_in)
+        v = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_v")(ctx_in)
+        q, k, v = (_split_heads(t, self.heads) for t in (q, k, v))
+
+        sim = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (self.dim_head ** -0.5)
+        probs = _stable_softmax(sim, self.dtype)
+
+        if probs.shape[-2] <= 1024:
+            # pre-edit store (AttentionControlEdit stores before editing,
+            # run_videop2p.py:304-305); head-mean commutes with LocalBlend's
+            # word-sum + site-mean (see control/local_blend.py).
+            self.sow("attn_store", "maps", probs.mean(axis=1))
+
+        if control is not None:
+            if video_length is None:
+                if self.site != "temporal":
+                    # at cross sites x is frame-folded (B·F, N, C): N is the
+                    # spatial-token count, not the frame count — require it
+                    raise ValueError("video_length is required at controlled cross sites")
+                video_length = x.shape[1]
+            probs = control_attention(
+                probs,
+                control.ctx,
+                is_cross=(self.site == "cross"),
+                step_index=control.step_index,
+                video_length=video_length,
+            )
+
+        out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+        out = _merge_heads(out)
+        kernel_init = nn.initializers.zeros if self.zero_init_out else None
+        kwargs = {"kernel_init": kernel_init} if kernel_init is not None else {}
+        return nn.Dense(inner, dtype=self.dtype, name="to_out", **kwargs)(out)
+
+
+class FeedForward(nn.Module):
+    """GEGLU feed-forward (diffusers ``FeedForward``/``GEGLU`` the reference
+    block uses, attention.py:190)."""
+
+    dim: int
+    mult: int = 4
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        inner = self.dim * self.mult
+        h = nn.Dense(inner * 2, dtype=self.dtype, name="proj_geglu")(x)
+        h, gate = jnp.split(h, 2, axis=-1)
+        h = h * nn.gelu(gate)
+        return nn.Dense(self.dim, dtype=self.dtype, name="proj_out")(h)
+
+
+class BasicTransformerBlock(nn.Module):
+    """frame-attn → text-cross-attn → FF → temporal-attn, all pre-LayerNorm
+    with residuals (reference BasicTransformerBlock, attention.py:140-268;
+    execution order :233-268)."""
+
+    dim: int
+    heads: int
+    dim_head: int
+    dtype: Dtype = jnp.float32
+    frame_attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jax.Array,
+        context: Optional[jax.Array] = None,
+        control: Optional[AttnControl] = None,
+    ) -> jax.Array:
+        b, f, n, c = x.shape
+
+        h = nn.LayerNorm(dtype=self.dtype, name="norm1")(x)
+        x = x + FrameAttention(
+            heads=self.heads, dim_head=self.dim_head, dtype=self.dtype,
+            attention_fn=self.frame_attention_fn, name="attn1",
+        )(h)
+
+        if context is not None:
+            # fold frames into batch, batch-major; repeat text per frame
+            # (attention.py:94-95). Per-frame context (B, F, 77, D) is the
+            # pipeline's "multi" embedding mode (pipeline_tuneavideo.py:366-367).
+            h = nn.LayerNorm(dtype=self.dtype, name="norm2")(x).reshape(b * f, n, c)
+            if context.ndim == 3:
+                ctx_flat = jnp.repeat(context, f, axis=0)
+            else:
+                ctx_flat = context.reshape(b * f, *context.shape[2:])
+            attn2 = ControlledAttention(
+                heads=self.heads, dim_head=self.dim_head, site="cross",
+                dtype=self.dtype, name="attn2",
+            )(h, context=ctx_flat, control=control, video_length=f)
+            x = x + attn2.reshape(b, f, n, c)
+
+        x = x + FeedForward(self.dim, dtype=self.dtype, name="ff")(
+            nn.LayerNorm(dtype=self.dtype, name="norm3")(x)
+        )
+
+        # temporal attention over the frame axis: (B, F, N, C) → (B·N, F, C),
+        # batch-major over spatial positions (attention.py:262-268)
+        h = nn.LayerNorm(dtype=self.dtype, name="norm_temp")(x)
+        h = h.transpose(0, 2, 1, 3).reshape(b * n, f, c)
+        attn_temp = ControlledAttention(
+            heads=self.heads, dim_head=self.dim_head, site="temporal",
+            zero_init_out=True, dtype=self.dtype, name="attn_temp",
+        )(h, control=control, video_length=f)
+        x = x + attn_temp.reshape(b, n, f, c).transpose(0, 2, 1, 3)
+        return x
+
+
+class Transformer3DModel(nn.Module):
+    """GroupNorm → proj_in → transformer blocks → proj_out, with residual
+    (reference Transformer3DModel, attention.py:32-137). Operates on
+    (B, F, H, W, C); spatial positions flatten to a token axis internally."""
+
+    heads: int
+    dim_head: int
+    depth: int = 1
+    norm_groups: int = 32
+    dtype: Dtype = jnp.float32
+    frame_attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jax.Array,
+        context: Optional[jax.Array] = None,
+        control: Optional[AttnControl] = None,
+    ) -> jax.Array:
+        b, f, hh, ww, c = x.shape
+        inner = self.heads * self.dim_head
+        residual = x
+
+        # fold frames into batch BEFORE the norm: the reference normalizes per
+        # frame (rearrange precedes self.norm, attention.py:94-101), whereas
+        # GroupNorm on (B, F, H, W, C) would pool statistics across frames
+        h = x.reshape(b * f, hh, ww, c)
+        h = nn.GroupNorm(
+            num_groups=self.norm_groups, epsilon=1e-6, dtype=self.dtype, name="norm"
+        )(h)
+        h = h.reshape(b, f, hh, ww, c)
+        # use_linear_projection=False in SD1.x is a 1×1 conv — identical to a
+        # Dense in channels-last layout (attention.py:74-81)
+        h = nn.Dense(inner, dtype=self.dtype, name="proj_in")(h)
+        h = h.reshape(b, f, hh * ww, inner)
+
+        for i in range(self.depth):
+            h = BasicTransformerBlock(
+                dim=inner, heads=self.heads, dim_head=self.dim_head,
+                dtype=self.dtype, frame_attention_fn=self.frame_attention_fn,
+                name=f"blocks_{i}",
+            )(h, context=context, control=control)
+
+        h = h.reshape(b, f, hh, ww, inner)
+        h = nn.Dense(c, dtype=self.dtype, name="proj_out")(h)
+        return h + residual

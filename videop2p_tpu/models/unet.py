@@ -1,0 +1,221 @@
+"""The 3-D (video) conditional UNet.
+
+TPU-native re-design of /root/reference/tuneavideo/models/unet.py
+(``UNet3DConditionModel``). Same topology as the inflated Stable-Diffusion 1.x
+denoiser — 3 cross-attn down blocks + 1 plain down block, cross-attn mid, the
+mirrored up path (unet.py:50-64) — expressed as a config-driven linen module
+over channels-last (B, F, H, W, C) activations.
+
+The topology is entirely config-driven (block types, widths, per-block
+transformer depth and head counts) so larger inflations (e.g. SDXL-shaped
+UNets at 1024²) are a config change, not a code change — the stress case
+SURVEY §7 calls out.
+
+Weight inflation from 2-D checkpoints (the reference's ``from_pretrained_2d``,
+unet.py:417-448) lives in :mod:`videop2p_tpu.models.convert`; the
+``'_temp.'``-keys-keep-init rule maps to the temporal attention's
+zero-initialized output projection here (models/attention.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from videop2p_tpu.models.attention import AttnControl
+from videop2p_tpu.models.layers import (
+    InflatedConv,
+    TimestepEmbedding,
+    get_timestep_embedding,
+)
+from videop2p_tpu.models import unet_blocks
+
+__all__ = ["UNet3DConfig", "UNet3DConditionModel"]
+
+
+def _per_block(value: Union[int, Tuple[int, ...]], num_blocks: int) -> Tuple[int, ...]:
+    if isinstance(value, int):
+        return (value,) * num_blocks
+    if len(value) != num_blocks:
+        raise ValueError(f"per-block value {value} does not match {num_blocks} blocks")
+    return tuple(value)
+
+
+@dataclasses.dataclass(frozen=True)
+class UNet3DConfig:
+    """Static architecture config (the reference's config-registered kwargs,
+    unet.py:42-79). Defaults are the SD-1.x shape."""
+
+    sample_size: int = 64
+    in_channels: int = 4
+    out_channels: int = 4
+    down_block_types: Tuple[str, ...] = (
+        "CrossAttnDownBlock3D",
+        "CrossAttnDownBlock3D",
+        "CrossAttnDownBlock3D",
+        "DownBlock3D",
+    )
+    up_block_types: Tuple[str, ...] = (
+        "UpBlock3D",
+        "CrossAttnUpBlock3D",
+        "CrossAttnUpBlock3D",
+        "CrossAttnUpBlock3D",
+    )
+    block_out_channels: Tuple[int, ...] = (320, 640, 1280, 1280)
+    layers_per_block: int = 2
+    # int, or per-block tuple (SDXL-style deep upper blocks)
+    transformer_depth: Union[int, Tuple[int, ...]] = 1
+    attention_head_dim: Union[int, Tuple[int, ...]] = 8  # = num heads (diffusers-0.11 naming)
+    cross_attention_dim: int = 768
+    norm_num_groups: int = 32
+    flip_sin_to_cos: bool = True
+    freq_shift: float = 0.0
+    gradient_checkpointing: bool = False
+
+    @classmethod
+    def sd15(cls, **overrides) -> "UNet3DConfig":
+        return cls(**overrides)
+
+    @classmethod
+    def tiny(cls, **overrides) -> "UNet3DConfig":
+        """Miniature config for tests: two levels, 8-wide, 2 heads."""
+        cfg = dict(
+            sample_size=8,
+            down_block_types=("CrossAttnDownBlock3D", "DownBlock3D"),
+            up_block_types=("UpBlock3D", "CrossAttnUpBlock3D"),
+            block_out_channels=(8, 16),
+            layers_per_block=1,
+            attention_head_dim=2,
+            cross_attention_dim=16,
+            norm_num_groups=4,
+        )
+        cfg.update(overrides)
+        return cls(**cfg)
+
+
+class UNet3DConditionModel(nn.Module):
+    """Video denoiser ε_θ(x_t, t, text) (reference forward: unet.py:279-415).
+
+    ``__call__(sample, timesteps, encoder_hidden_states, control=None)``:
+      * ``sample``: (B, F, H, W, in_channels) latents;
+      * ``timesteps``: () or (B,) int;
+      * ``encoder_hidden_states``: (B, L, cross_attention_dim) text states, or
+        (B, F, L, D) for per-frame embeddings;
+      * ``control``: optional :class:`AttnControl` — threads the P2P edit into
+        every text-cross / temporal attention site.
+
+    Run with ``mutable=["attn_store"]`` to also collect head-averaged
+    attention maps from every controlled site with ≤32² queries (the
+    reference's ``AttentionStore``).
+    """
+
+    config: UNet3DConfig
+    dtype: jnp.dtype = jnp.float32
+    frame_attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(
+        self,
+        sample: jax.Array,
+        timesteps: jax.Array,
+        encoder_hidden_states: jax.Array,
+        control: Optional[AttnControl] = None,
+    ) -> jax.Array:
+        cfg = self.config
+        n_blocks = len(cfg.block_out_channels)
+        depths = _per_block(cfg.transformer_depth, n_blocks)
+        heads = _per_block(cfg.attention_head_dim, n_blocks)
+
+        # --- time embedding (unet.py:324-346) ---
+        timesteps = jnp.asarray(timesteps)
+        if timesteps.ndim == 0:
+            timesteps = jnp.broadcast_to(timesteps, (sample.shape[0],))
+        temb = get_timestep_embedding(
+            timesteps,
+            cfg.block_out_channels[0],
+            flip_sin_to_cos=cfg.flip_sin_to_cos,
+            downscale_freq_shift=cfg.freq_shift,
+        ).astype(self.dtype)
+        temb = TimestepEmbedding(
+            cfg.block_out_channels[0] * 4, dtype=self.dtype, name="time_embedding"
+        )(temb)
+
+        # --- down path (unet.py:359-374) ---
+        x = InflatedConv(cfg.block_out_channels[0], dtype=self.dtype, name="conv_in")(sample)
+        res_stack = [x]
+        for i, block_type in enumerate(cfg.down_block_types):
+            is_final = i == n_blocks - 1
+            block = unet_blocks.get_down_block(
+                block_type,
+                remat=cfg.gradient_checkpointing,
+                out_channels=cfg.block_out_channels[i],
+                num_layers=cfg.layers_per_block,
+                transformer_depth=depths[i],
+                attn_heads=heads[i],
+                add_downsample=not is_final,
+                norm_groups=cfg.norm_num_groups,
+                dtype=self.dtype,
+                frame_attention_fn=self.frame_attention_fn,
+                name=f"down_blocks_{i}",
+            )
+            if block_type == "CrossAttnDownBlock3D":
+                x, res = block(x, temb, encoder_hidden_states, control)
+            else:
+                x, res = block(x, temb)
+            res_stack.extend(res)
+
+        # --- mid (unet.py:377) ---
+        mid_cls = (
+            nn.remat(unet_blocks.UNetMidBlock3DCrossAttn)
+            if cfg.gradient_checkpointing
+            else unet_blocks.UNetMidBlock3DCrossAttn
+        )
+        x = mid_cls(
+            channels=cfg.block_out_channels[-1],
+            transformer_depth=depths[-1],
+            attn_heads=heads[-1],
+            norm_groups=cfg.norm_num_groups,
+            dtype=self.dtype,
+            frame_attention_fn=self.frame_attention_fn,
+            name="mid_block",
+        )(x, temb, encoder_hidden_states, control)
+
+        # --- up path (unet.py:382-405) ---
+        rev_channels = tuple(reversed(cfg.block_out_channels))
+        rev_heads = tuple(reversed(heads))
+        rev_depths = tuple(reversed(depths))
+        for i, block_type in enumerate(cfg.up_block_types):
+            is_final = i == n_blocks - 1
+            num_layers = cfg.layers_per_block + 1
+            res = tuple(res_stack[-num_layers:])
+            del res_stack[-num_layers:]
+            block = unet_blocks.get_up_block(
+                block_type,
+                remat=cfg.gradient_checkpointing,
+                out_channels=rev_channels[i],
+                num_layers=num_layers,
+                transformer_depth=rev_depths[i],
+                attn_heads=rev_heads[i],
+                add_upsample=not is_final,
+                norm_groups=cfg.norm_num_groups,
+                dtype=self.dtype,
+                frame_attention_fn=self.frame_attention_fn,
+                name=f"up_blocks_{i}",
+            )
+            if block_type == "CrossAttnUpBlock3D":
+                x = block(x, res, temb, encoder_hidden_states, control)
+            else:
+                x = block(x, res, temb)
+
+        # --- out (unet.py:407-409) ---
+        x = nn.GroupNorm(
+            num_groups=cfg.norm_num_groups, epsilon=1e-5, dtype=self.dtype,
+            name="conv_norm_out",
+        )(x)
+        x = nn.silu(x)
+        x = InflatedConv(cfg.out_channels, dtype=self.dtype, name="conv_out")(x)
+        return x
