@@ -1,0 +1,14 @@
+"""Latent-space pipelines: controlled sampling, DDIM inversion, null-text."""
+
+from videop2p_tpu.pipelines.inversion import ddim_inversion, null_text_optimization
+from videop2p_tpu.pipelines.sampling import edit_sample, make_unet_fn
+from videop2p_tpu.pipelines.stores import blend_maps_from_store, flatten_store
+
+__all__ = [
+    "ddim_inversion",
+    "null_text_optimization",
+    "edit_sample",
+    "make_unet_fn",
+    "blend_maps_from_store",
+    "flatten_store",
+]

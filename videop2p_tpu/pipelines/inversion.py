@@ -1,0 +1,160 @@
+"""DDIM inversion and null-text inversion.
+
+TPU-native re-design of the reference ``NullInversion``
+(/root/reference/run_videop2p.py:443-648) and the Stage-1 validation inversion
+(/root/reference/tuneavideo/util.py:52-92):
+
+  * ``ddim_inversion`` — 50 forward-DDIM steps, conditional-only (guidance 1),
+    as a ``lax.scan`` that keeps the full latent trajectory
+    (run_videop2p.py:558-578). The fork's dependent-noise blend
+    ``(1-w)·ε̂ + w·ar_noise`` (run_videop2p.py:465-471) is key-threaded.
+  * ``null_text_optimization`` — per-step optimization of the unconditional
+    embedding (run_videop2p.py:580-612): outer scan over the 50 steps, inner
+    ``lax.while_loop`` Adam with the reference's decayed lr
+    ``1e-2·(1−i/100)``, ≤``num_inner_steps`` iterations and early stop at
+    ``loss < ε + i·2e-5`` — the early stop becomes the while condition, so
+    shapes stay static under jit.
+
+The reference's Python-loop-with-break structure is the hard functionalization
+case SURVEY §7 ranks #3; the while_loop preserves its exact update-then-check
+semantics (loss is measured pre-update, the update it gated is still applied).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from videop2p_tpu.core.ddim import DDIMScheduler
+from videop2p_tpu.core.noise import DependentNoiseSampler
+from videop2p_tpu.pipelines.sampling import UNetFn
+
+__all__ = ["ddim_inversion", "null_text_optimization"]
+
+
+def ddim_inversion(
+    unet_fn: UNetFn,
+    params,
+    scheduler: DDIMScheduler,
+    latents: jax.Array,
+    cond_embedding: jax.Array,
+    *,
+    num_inference_steps: int = 50,
+    dependent_weight: float = 0.0,
+    dependent_sampler: Optional[DependentNoiseSampler] = None,
+    key: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Invert clean latents x_0 to noise x_T.
+
+    ``latents``: (B, F, h, w, C) clean (VAE-encoded, scaled) latents;
+    ``cond_embedding``: (B, L, D) source-prompt embedding (no CFG — the
+    reference inverts with guidance 1, run_videop2p.py:558-572).
+
+    Returns the full trajectory (num_steps+1, B, F, h, w, C) with
+    ``[0] = x_0`` and ``[-1] = x_T`` (the reference's ``all_latent`` list).
+    ``dependent_weight > 0`` blends the model output with AR noise:
+    ``ε = (1-w)·ε̂ + w·ar_noise`` (run_videop2p.py:467-471).
+    """
+    # latents stay float32 through the walk regardless of the UNet's compute
+    # dtype — scheduler math is fp32 (the reference keeps the Stage-2 UNet and
+    # latents fp32 for inversion fidelity, run_videop2p.py:111-113)
+    latents = latents.astype(jnp.float32)
+    # ascending timesteps: the reference walks timesteps[-(i+1)] for i in 0..N
+    # (run_videop2p.py:563-566)
+    timesteps = jnp.asarray(scheduler.timesteps(num_inference_steps)[::-1].copy())
+    if key is None:
+        key = jax.random.key(0)
+
+    def body(carry, t):
+        latent, key = carry
+        eps, _ = unet_fn(params, latent, t, cond_embedding, None)
+        if dependent_weight > 0.0:
+            if dependent_sampler is None:
+                raise ValueError("dependent_weight > 0 requires dependent_sampler")
+            key, sub = jax.random.split(key)
+            ar_noise = dependent_sampler.sample_like(sub, eps)
+            eps = (1.0 - dependent_weight) * eps + dependent_weight * ar_noise
+        latent = scheduler.next_step(eps, t, latent, num_inference_steps)
+        return (latent, key), latent
+
+    (_, _), trajectory = jax.lax.scan(body, (latents, key), timesteps)
+    return jnp.concatenate([latents[None], trajectory], axis=0)
+
+
+def null_text_optimization(
+    unet_fn: UNetFn,
+    params,
+    scheduler: DDIMScheduler,
+    trajectory: jax.Array,
+    cond_embedding: jax.Array,
+    uncond_embedding: jax.Array,
+    *,
+    num_inference_steps: int = 50,
+    guidance_scale: float = 7.5,
+    num_inner_steps: int = 10,
+    epsilon: float = 1e-5,
+) -> jax.Array:
+    """Optimize a per-step unconditional embedding that makes CFG denoising
+    replay the recorded inversion trajectory (run_videop2p.py:580-612).
+
+    ``trajectory``: (num_steps+1, B, F, h, w, C) from :func:`ddim_inversion`;
+    ``cond_embedding`` / ``uncond_embedding``: (B, L, D).
+    Returns per-step uncond embeddings (num_steps, B, L, D) to feed
+    ``edit_sample``'s injection seam.
+    """
+    timesteps = jnp.asarray(scheduler.timesteps(num_inference_steps))
+    # latent_prev for outer step i is trajectory[num - i - 1]
+    # (the reference's latents[len - i - 2], run_videop2p.py:585)
+    prev_seq = trajectory[::-1][1:]
+    steps = jnp.arange(num_inference_steps)
+    lr_seq = 1e-2 * (1.0 - steps / 100.0)  # run_videop2p.py:588
+    thresh_seq = epsilon + steps * 2e-5  # run_videop2p.py:603
+    # Adam direction with unit lr; the decayed per-step lr scales the update
+    adam = optax.adam(1.0)
+
+    def cond_pred(latent, t):
+        eps, _ = unet_fn(params, latent, t, cond_embedding, None)
+        return eps
+
+    def outer(carry, xs):
+        latent_cur, uncond = carry
+        t, latent_prev, lr, thresh = xs
+        eps_cond = jax.lax.stop_gradient(cond_pred(latent_cur, t))
+
+        def loss_fn(u):
+            eps_uncond, _ = unet_fn(params, latent_cur, t, u, None)
+            eps = eps_uncond + guidance_scale * (eps_cond - eps_uncond)
+            prev_rec = scheduler.prev_step(eps, t, latent_cur, num_inference_steps)
+            return jnp.mean((prev_rec - latent_prev) ** 2)
+
+        def inner_cond(state):
+            _, _, last_loss, j = state
+            return jnp.logical_and(j < num_inner_steps, last_loss >= thresh)
+
+        def inner_body(state):
+            u, opt_state, _, j = state
+            loss, grads = jax.value_and_grad(loss_fn)(u)
+            updates, opt_state = adam.update(grads, opt_state, u)
+            u = optax.apply_updates(u, jax.tree.map(lambda g: lr * g, updates))
+            return (u, opt_state, loss, j + 1)
+
+        opt_state = adam.init(uncond)
+        uncond, _, _, _ = jax.lax.while_loop(
+            inner_cond, inner_body, (uncond, opt_state, jnp.inf, 0)
+        )
+
+        # advance with the optimized embedding under full CFG
+        # (run_videop2p.py:606-610)
+        eps_uncond, _ = unet_fn(params, latent_cur, t, uncond, None)
+        eps = eps_uncond + guidance_scale * (eps_cond - eps_uncond)
+        latent_cur = scheduler.prev_step(eps, t, latent_cur, num_inference_steps)
+        return (latent_cur, uncond), uncond
+
+    x_t = trajectory[-1]
+    (_, _), uncond_seq = jax.lax.scan(
+        outer, (x_t, uncond_embedding), (timesteps, prev_seq, lr_seq, thresh_seq)
+    )
+    return uncond_seq

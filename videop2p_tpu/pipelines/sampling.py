@@ -1,0 +1,197 @@
+"""The attention-controlled denoising loop (Stage-2 editing / validation
+sampling).
+
+TPU-native re-design of ``TuneAVideoPipeline.__call__``'s denoise loop
+(/root/reference/tuneavideo/pipelines/pipeline_tuneavideo.py:321-441) as one
+``lax.scan`` under ``jit``:
+
+  * CFG batch ``[uncond×P, cond×P]`` (pipeline_tuneavideo.py:235);
+  * per-step null-embedding injection — the optimized uncond embedding for
+    step *i* replaces the static one (pipeline_tuneavideo.py:399-403);
+  * fast-mode source branch: the source stream's prediction is its cond-only
+    output so DDIM inversion replays exactly (pipeline_tuneavideo.py:412-415);
+  * scheduler step with optional η-variance noise from the dependent sampler
+    (dependent_ddim.py:320-334), key-threaded;
+  * the controller sees every text-cross/temporal attention site via the
+    functional control context, and LocalBlend runs as the step callback on a
+    running sum of blend-site maps carried through the scan
+    (pipeline_tuneavideo.py:423-424, run_videop2p.py:261-291).
+
+The pipeline operates purely in latent space; VAE encode/decode and text
+encoding are the caller's (CLI's) concern — that keeps this scan free of
+host I/O and lets the whole edit jit to one XLA program.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from videop2p_tpu.control.controllers import ControlContext
+from videop2p_tpu.control.local_blend import local_blend
+from videop2p_tpu.core.ddim import DDIMScheduler
+from videop2p_tpu.core.noise import DependentNoiseSampler
+from videop2p_tpu.models.attention import AttnControl
+from videop2p_tpu.pipelines.stores import blend_maps_from_store
+
+__all__ = ["edit_sample", "make_unet_fn"]
+
+# (params, sample, t, text, control) -> (eps, attn_store)
+UNetFn = Callable[..., Tuple[jax.Array, dict]]
+
+
+def make_unet_fn(model) -> UNetFn:
+    """Adapter from a linen UNet module to the pipeline's callable contract."""
+
+    def fn(params, sample, t, text, control=None):
+        # init() also returns an "attn_store" collection (sow runs during
+        # init); passing it back into apply would make sow append a second
+        # entry per site — keep only the parameter collections.
+        variables = {k: v for k, v in params.items() if k != "attn_store"}
+        out, store = model.apply(
+            variables, sample, t, text, control, mutable=["attn_store"]
+        )
+        return out, store
+
+    return fn
+
+
+def edit_sample(
+    unet_fn: UNetFn,
+    params,
+    scheduler: DDIMScheduler,
+    latents: jax.Array,
+    cond_embeddings: jax.Array,
+    uncond_embeddings: jax.Array,
+    *,
+    num_inference_steps: int = 50,
+    guidance_scale: float = 7.5,
+    ctx: Optional[ControlContext] = None,
+    source_uses_cfg: bool = True,
+    eta: float = 0.0,
+    key: Optional[jax.Array] = None,
+    dependent_sampler: Optional[DependentNoiseSampler] = None,
+    blend_res: Optional[Tuple[int, int]] = None,
+) -> jax.Array:
+    """Run the controlled denoise loop; returns final latents (P, F, h, w, C).
+
+    ``latents``: x_T, shape (1, F, h, w, C) or (P, F, h, w, C) — a batch-1
+    latent is expanded so source & edit share x_T (the reference's
+    ``prepare_latents`` expansion, pipeline_tuneavideo.py:312-314).
+    ``cond_embeddings``: (P, L, D) text embeddings, source prompt first.
+    ``uncond_embeddings``: (L, D) static, or (num_steps, L, D) per-step
+    (null-text inversion output, injected per step).
+    ``source_uses_cfg=False`` is the --fast mode source branch.
+    """
+    P = cond_embeddings.shape[0]
+    # latents stay float32 in the scan carry; the UNet casts to its own
+    # compute dtype internally (scheduler math is fp32 for step fidelity)
+    latents = latents.astype(jnp.float32)
+    if latents.shape[0] == 1 and P > 1:
+        latents = jnp.broadcast_to(latents, (P,) + latents.shape[1:])
+    elif latents.shape[0] != P:
+        raise ValueError(f"latents batch {latents.shape[0]} != num prompts {P}")
+    video_length = latents.shape[1]
+    latent_hw = latents.shape[2:4]
+    text_len = cond_embeddings.shape[1]
+
+    timesteps = jnp.asarray(scheduler.timesteps(num_inference_steps))
+    # accepted shapes: (L, D) or (1, L, D) static; (num_steps, L, D) or
+    # (num_steps, 1, L, D) per-step (null_text_optimization output, injected
+    # per step and shared across prompt streams — run_videop2p.py:399-403)
+    if uncond_embeddings.ndim == 4:
+        if uncond_embeddings.shape[1] != 1:
+            raise ValueError(
+                "per-step uncond embeddings must be optimized on the batch-1 "
+                f"source stream, got shape {uncond_embeddings.shape}"
+            )
+        uncond_embeddings = uncond_embeddings[:, 0]
+    elif uncond_embeddings.ndim == 3 and uncond_embeddings.shape[0] == 1:
+        # a batched text-encoder output (1, L, D), not a per-step sequence
+        uncond_embeddings = uncond_embeddings[0]
+    if uncond_embeddings.ndim == 2:
+        uncond_seq = jnp.broadcast_to(
+            uncond_embeddings[None], (num_inference_steps,) + uncond_embeddings.shape
+        )
+    elif uncond_embeddings.ndim == 3 and uncond_embeddings.shape[0] == num_inference_steps:
+        uncond_seq = uncond_embeddings
+    else:
+        raise ValueError(
+            f"per-step uncond embeddings must have leading dim {num_inference_steps}, "
+            f"got {uncond_embeddings.shape}"
+        )
+
+    if key is None:
+        key = jax.random.key(0)
+    use_blend = ctx is not None and ctx.blend is not None
+
+    def step_text(uncond):
+        u = jnp.broadcast_to(uncond[None], (P,) + uncond.shape)
+        return jnp.concatenate([u, cond_embeddings], axis=0)
+
+    maps_sum = None
+    if use_blend:
+        # fixed carry shape: count blend sites from an abstract forward
+        control0 = AttnControl(ctx=ctx, step_index=jnp.asarray(0))
+        _, store_shape = jax.eval_shape(
+            unet_fn,
+            params,
+            jnp.concatenate([latents, latents], axis=0),
+            timesteps[0],
+            step_text(uncond_seq[0]),
+            control0,
+        )
+        maps_shape = jax.eval_shape(
+            lambda s: blend_maps_from_store(
+                s,
+                latent_hw=latent_hw,
+                video_length=video_length,
+                num_prompts=P,
+                text_len=text_len,
+                blend_res=blend_res,
+            ),
+            store_shape,
+        )
+        maps_sum = jnp.zeros(maps_shape.shape, maps_shape.dtype)
+
+    def body(carry, xs):
+        latents, maps_sum, key = carry
+        t, i, uncond = xs
+        latent_in = jnp.concatenate([latents, latents], axis=0)
+        text = step_text(uncond)
+        control = AttnControl(ctx=ctx, step_index=i) if ctx is not None else None
+        eps_all, store = unet_fn(params, latent_in, t, text, control)
+        eps_uncond, eps_text = eps_all[:P], eps_all[P:]
+        eps = eps_uncond + guidance_scale * (eps_text - eps_uncond)
+        if not source_uses_cfg:
+            eps = eps.at[0].set(eps_text[0])
+
+        key, sub = jax.random.split(key)
+        variance_noise = None
+        if eta > 0:
+            if dependent_sampler is not None:
+                variance_noise = dependent_sampler.sample_like(sub, eps)
+            else:
+                variance_noise = jax.random.normal(sub, eps.shape, eps.dtype)
+
+        latents, _ = scheduler.step(
+            eps, t, latents, num_inference_steps, eta=eta, variance_noise=variance_noise
+        )
+
+        if use_blend:
+            maps_sum = maps_sum + blend_maps_from_store(
+                store,
+                latent_hw=latent_hw,
+                video_length=video_length,
+                num_prompts=P,
+                text_len=text_len,
+                blend_res=blend_res,
+            )
+            latents = local_blend(latents, maps_sum, ctx.blend, i)
+        return (latents, maps_sum, key), None
+
+    xs = (timesteps, jnp.arange(num_inference_steps), uncond_seq)
+    (latents, _, _), _ = jax.lax.scan(body, (latents, maps_sum, key), xs)
+    return latents

@@ -1,0 +1,69 @@
+"""Attention-store plumbing between the UNet's sown maps and the control layer.
+
+The reference's ``AttentionStore`` keeps per-step lists keyed
+``{down,mid,up}_{cross,self}`` and LocalBlend consumes
+``down_cross[2:4] + up_cross[:3]`` — exactly the cross-attention sites whose
+query grid is (latent/4)² (run_videop2p.py:145, 251-268; SURVEY §3.4). Here the
+UNet sows head-averaged maps into a flax collection; these helpers select the
+blend sites by that resolution rule and stack them into the fixed-shape
+``(P, F, S, r, r, L)`` tensor ``local_blend`` expects, so the running sum can
+live in a ``lax.scan`` carry.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["blend_maps_from_store", "flatten_store"]
+
+
+def flatten_store(store: Dict[str, Any]) -> List[Tuple[str, jax.Array]]:
+    """(path, leaf) pairs in deterministic tree order. Each leaf is a sown
+    head-mean probability map: cross sites (B·F, Q, L); temporal sites
+    (B·N, F, F)."""
+    flat = jax.tree_util.tree_flatten_with_path(store)[0]
+    return [(jax.tree_util.keystr(path), leaf) for path, leaf in flat]
+
+
+def _select_blend_leaves(
+    store: Dict[str, Any], blend_res: Tuple[int, int], text_len: int
+) -> List[jax.Array]:
+    q_blend = blend_res[0] * blend_res[1]
+    out = []
+    for path, leaf in flatten_store(store):
+        if "attn2" in path and leaf.shape[-1] == text_len and leaf.shape[-2] == q_blend:
+            out.append(leaf)
+    return out
+
+
+def blend_maps_from_store(
+    store: Dict[str, Any],
+    *,
+    latent_hw: Tuple[int, int],
+    video_length: int,
+    num_prompts: int,
+    text_len: int,
+    blend_res: Tuple[int, int] | None = None,
+) -> jax.Array:
+    """Stack the blend-site cross maps into (P, F, S, r, r, L).
+
+    Blend sites are the cross-attention layers at (latent/4)² queries — the
+    16×16 maps for a 64² latent, generalizing the reference's hard-coded
+    ``reshape(2, -1, 8, 16, 16, 77)`` (run_videop2p.py:146) to any latent size
+    and frame count. Only the conditional (CFG) half is kept, matching the
+    store's conditional-half rule (run_videop2p.py:217-218).
+    """
+    r = blend_res if blend_res is not None else (latent_hw[0] // 4, latent_hw[1] // 4)
+    leaves = _select_blend_leaves(store, r, text_len)
+    if not leaves:
+        raise ValueError(
+            f"no cross-attention maps at blend resolution {r} in store "
+            f"(text_len={text_len}) — latent_hw mismatch?"
+        )
+    stacked = jnp.stack(leaves, axis=1)  # (2·P·F, S, Q, L)
+    b2pf, s, q, L = stacked.shape
+    stacked = stacked.reshape(2, num_prompts, video_length, s, r[0], r[1], L)
+    return stacked[1]  # conditional half → (P, F, S, r, r, L)
