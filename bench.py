@@ -1,0 +1,94 @@
+"""Benchmark of record: fast-mode Stage-2 edit wall-clock on real hardware.
+
+Measures the reference's headline scenario (README.md:56-57): an 8-frame
+512×512 (64×64-latent) video edit with 50 DDIM steps in --fast mode — DDIM
+inversion (cond-only) + the attention-controlled CFG denoise with
+refine+reweight controllers and LocalBlend — on whatever accelerator is
+attached (one TPU v5e chip under axon). Weights are random-init: wall-clock
+of the jitted compute is weight-value-independent, and no SD checkpoint ships
+in this image.
+
+Prints ONE JSON line:
+  {"metric": "fast_edit_e2e_wall", "value": <seconds>, "unit": "s",
+   "vs_baseline": <V100_baseline / ours>}   (>1 ⇒ faster than the reference)
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+V100_FAST_EDIT_S = 60.0  # reference: "~1 min on V100" (README.md:56-57)
+
+
+def main() -> None:
+    from videop2p_tpu.control import make_controller
+    from videop2p_tpu.core import DDIMScheduler
+    from videop2p_tpu.models import UNet3DConditionModel, UNet3DConfig
+    from videop2p_tpu.pipelines import ddim_inversion, edit_sample, make_unet_fn
+    from videop2p_tpu.utils.tokenizers import WordTokenizer
+
+    cfg = UNet3DConfig.sd15()
+    model = UNet3DConditionModel(config=cfg, dtype=jnp.bfloat16)
+    F, STEPS = 8, 50
+    x0 = jax.random.normal(jax.random.key(0), (1, F, 64, 64, 4), jnp.bfloat16)
+    cond = jax.random.normal(jax.random.key(1), (2, 77, 768), jnp.bfloat16)
+    uncond = jnp.zeros((77, 768), jnp.bfloat16)
+    params = jax.jit(model.init)(jax.random.key(2), x0, jnp.asarray(10), cond[:1])
+    fn = make_unet_fn(model)
+    sched = DDIMScheduler.create_sd()
+
+    # rabbit-jump-p2p working point: refine + reweight + LocalBlend
+    # (configs/rabbit-jump-p2p.yaml)
+    ctx = make_controller(
+        ["a rabbit is jumping on the grass", "a origami rabbit is jumping on the grass"],
+        WordTokenizer(),
+        num_steps=STEPS,
+        is_replace_controller=False,
+        cross_replace_steps=0.2,
+        self_replace_steps=0.5,
+        blend_words=(["rabbit"], ["rabbit"]),
+        equalizer_params={"words": ["origami"], "values": [2.0]},
+    )
+
+    invert = jax.jit(
+        lambda p, x: ddim_inversion(fn, p, sched, x, cond[:1], num_inference_steps=STEPS)
+    )
+    edit = jax.jit(
+        lambda p, xt: edit_sample(
+            fn, p, sched, xt, cond, uncond,
+            num_inference_steps=STEPS, ctx=ctx, source_uses_cfg=False,
+        )
+    )
+
+    # warm-up (compile) on a DIFFERENT input: the axon tunnel memoizes
+    # repeated identical (executable, args) calls, which would fake a
+    # near-zero wall-clock for the measured run
+    x_warm = jax.random.normal(jax.random.key(7), x0.shape, x0.dtype)
+    out = edit(params, invert(params, x_warm)[-1])
+    jax.block_until_ready(out)
+
+    t0 = time.time()
+    traj = invert(params, x0)
+    out = edit(params, traj[-1])
+    jax.block_until_ready(out)
+    elapsed = time.time() - t0
+
+    assert bool(jnp.isfinite(out.astype(jnp.float32)).all()), "non-finite output"
+    print(
+        json.dumps(
+            {
+                "metric": "fast_edit_e2e_wall",
+                "value": round(elapsed, 3),
+                "unit": "s",
+                "vs_baseline": round(V100_FAST_EDIT_S / elapsed, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,17 +1,23 @@
-"""Test configuration: force a virtual 8-device CPU platform BEFORE jax import.
+"""Test configuration: force a virtual 8-device CPU platform.
 
 This is the standard way to exercise pjit/shard_map sharding without a TPU pod
 (SURVEY §4): tests that need a mesh get 8 host devices; everything else just
 runs on CPU for speed and determinism.
+
+The XLA flag must be set BEFORE jax import; the platform override must happen
+AFTER — this image's sitecustomize registers the `axon` TPU plugin in every
+interpreter and hard-sets ``jax_platforms="axon,cpu"`` via jax.config, which
+wins over the JAX_PLATFORMS env var, so only a later ``jax.config.update``
+actually selects the CPU backend.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")

@@ -1,0 +1,72 @@
+"""Frame-attention kernel tests (CPU: chunked vs dense exactness, dispatch).
+
+The Pallas flash path needs a real TPU; it is exercised by bench.py and the
+verify drive. Here we pin the chunked kernel's exactness and the dispatch
+rules the UNet relies on.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from videop2p_tpu.ops import (
+    chunked_frame_attention,
+    dense_frame_attention,
+    make_frame_attention_fn,
+)
+
+
+def _rand_qkv(key, B=1, F=3, H=2, N=1024, D=8):
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, F, H, N, D))
+    k = jax.random.normal(kk, (B, H, N, D))
+    v = jax.random.normal(kv, (B, H, N, D))
+    return q, k, v
+
+
+def test_chunked_matches_dense():
+    q, k, v = _rand_qkv(jax.random.key(0))
+    out_c = jax.jit(lambda q, k, v: chunked_frame_attention(q, k, v, q_chunk=256))(q, k, v)
+    out_d = jax.jit(dense_frame_attention)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out_c), np.asarray(out_d), atol=1e-5)
+
+
+def test_chunked_grad_matches_dense():
+    q, k, v = _rand_qkv(jax.random.key(1), N=512, D=4)
+
+    def loss(fn, q):
+        return jnp.sum(fn(q, k, v) ** 2)
+
+    g_c = jax.jit(jax.grad(lambda q: loss(
+        lambda q, k, v: chunked_frame_attention(q, k, v, q_chunk=128), q)))(q)
+    g_d = jax.jit(jax.grad(lambda q: loss(dense_frame_attention, q)))(q)
+    np.testing.assert_allclose(np.asarray(g_c), np.asarray(g_d), atol=1e-4)
+
+
+def test_chunked_falls_back_on_indivisible():
+    q, k, v = _rand_qkv(jax.random.key(2), N=96)
+    out = chunked_frame_attention(q, k, v, q_chunk=512)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(dense_frame_attention(q, k, v)), atol=1e-5
+    )
+
+
+def test_dispatch_rules():
+    assert make_frame_attention_fn("dense") is None
+    fn = make_frame_attention_fn("chunked", min_large_tokens=1024)
+    # small site → dense path
+    q, k, v = _rand_qkv(jax.random.key(3), N=64)
+    out = fn(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(dense_frame_attention(q, k, v)), atol=1e-5
+    )
+    # large site off-TPU → chunked (still exact)
+    q, k, v = _rand_qkv(jax.random.key(4), N=2048, D=4)
+    out = jax.jit(fn)(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(dense_frame_attention(q, k, v)), atol=1e-5
+    )
+    import pytest
+
+    with pytest.raises(ValueError, match="unknown frame attention impl"):
+        make_frame_attention_fn("nope")

@@ -144,14 +144,15 @@ def test_null_text_optimization_improves_replay(sched):
     )(traj)
     assert uncond_seq.shape == (STEPS,) + uncond.shape
 
-    def replay(u):
+    def replay(null_seq):
         return edit_sample(
-            fn, None, sched, traj[-1], cond, u,
+            fn, None, sched, traj[-1], cond, uncond,
             num_inference_steps=STEPS, guidance_scale=7.5, source_uses_cfg=True,
+            null_uncond_embeddings=null_seq,
         )
 
     err_opt = np.mean(np.abs(np.asarray(replay(uncond_seq)[0] - x0[0])))
-    err_raw = np.mean(np.abs(np.asarray(replay(uncond[0])[0] - x0[0])))
+    err_raw = np.mean(np.abs(np.asarray(replay(None)[0] - x0[0])))
     assert err_opt < err_raw * 0.5, (err_opt, err_raw)
 
 
@@ -216,3 +217,31 @@ def test_eta_dependent_noise_path(sched):
     )
     assert out_eta.shape == out_det.shape
     assert not np.allclose(np.asarray(out_eta), np.asarray(out_det))
+
+
+def test_null_text_dependent_mode(sched):
+    """Dependent mode threads AR-noise blends through every prediction
+    (run_videop2p.py:465-487) and stays finite; lr clamps at 0 for >100 steps."""
+    from videop2p_tpu.core import DependentNoiseSampler
+
+    fn = text_unet()
+    sampler = DependentNoiseSampler.create(num_frames=2, decay_rate=0.5, window_size=2)
+    x0 = jax.random.normal(jax.random.key(0), SHAPE)
+    cond = 0.3 * jnp.ones((1, 77, 8))
+    uncond = jnp.zeros((1, 77, 8))
+    traj = ddim_inversion(
+        fn, None, sched, x0, cond, num_inference_steps=STEPS,
+        dependent_weight=0.3, dependent_sampler=sampler, key=jax.random.key(1),
+    )
+    out = jax.jit(
+        lambda tr: null_text_optimization(
+            fn, None, sched, tr, cond, uncond, num_inference_steps=STEPS,
+            dependent_weight=0.3, dependent_sampler=sampler, key=jax.random.key(2),
+        )
+    )(traj)
+    assert out.shape == (STEPS, 1, 77, 8)
+    assert np.isfinite(np.asarray(out)).all()
+    import pytest
+    with pytest.raises(ValueError, match="requires dependent_sampler"):
+        null_text_optimization(fn, None, sched, traj, cond, uncond,
+                               num_inference_steps=STEPS, dependent_weight=0.3)

@@ -33,6 +33,7 @@ from videop2p_tpu.models.layers import (
     get_timestep_embedding,
 )
 from videop2p_tpu.models import unet_blocks
+from videop2p_tpu.ops.attention import make_frame_attention_fn
 
 __all__ = ["UNet3DConfig", "UNet3DConditionModel"]
 
@@ -75,6 +76,9 @@ class UNet3DConfig:
     flip_sin_to_cos: bool = True
     freq_shift: float = 0.0
     gradient_checkpointing: bool = False
+    # frame-attention kernel: "auto"/"dense" (inference), "chunked"
+    # (training: memory-bounded backward), "flash" (Pallas; see ops/attention.py)
+    frame_attention: str = "auto"
 
     @classmethod
     def sd15(cls, **overrides) -> "UNet3DConfig":
@@ -129,6 +133,11 @@ class UNet3DConditionModel(nn.Module):
         n_blocks = len(cfg.block_out_channels)
         depths = _per_block(cfg.transformer_depth, n_blocks)
         heads = _per_block(cfg.attention_head_dim, n_blocks)
+        frame_attention_fn = (
+            self.frame_attention_fn
+            if self.frame_attention_fn is not None
+            else make_frame_attention_fn(cfg.frame_attention)
+        )
 
         # --- time embedding (unet.py:324-346) ---
         timesteps = jnp.asarray(timesteps)
@@ -159,7 +168,7 @@ class UNet3DConditionModel(nn.Module):
                 add_downsample=not is_final,
                 norm_groups=cfg.norm_num_groups,
                 dtype=self.dtype,
-                frame_attention_fn=self.frame_attention_fn,
+                frame_attention_fn=frame_attention_fn,
                 name=f"down_blocks_{i}",
             )
             if block_type == "CrossAttnDownBlock3D":
@@ -180,7 +189,7 @@ class UNet3DConditionModel(nn.Module):
             attn_heads=heads[-1],
             norm_groups=cfg.norm_num_groups,
             dtype=self.dtype,
-            frame_attention_fn=self.frame_attention_fn,
+            frame_attention_fn=frame_attention_fn,
             name="mid_block",
         )(x, temb, encoder_hidden_states, control)
 
@@ -203,7 +212,7 @@ class UNet3DConditionModel(nn.Module):
                 add_upsample=not is_final,
                 norm_groups=cfg.norm_num_groups,
                 dtype=self.dtype,
-                frame_attention_fn=self.frame_attention_fn,
+                frame_attention_fn=frame_attention_fn,
                 name=f"up_blocks_{i}",
             )
             if block_type == "CrossAttnUpBlock3D":

@@ -1,0 +1,13 @@
+"""Hot-path kernels: flash/chunked attention for the spatial frame attention."""
+
+from videop2p_tpu.ops.attention import (
+    chunked_frame_attention,
+    dense_frame_attention,
+    make_frame_attention_fn,
+)
+
+__all__ = [
+    "chunked_frame_attention",
+    "dense_frame_attention",
+    "make_frame_attention_fn",
+]

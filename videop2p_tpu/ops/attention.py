@@ -1,0 +1,121 @@
+"""Frame-attention kernels: Pallas flash attention on TPU, chunked fallback.
+
+The spatial frame attention (every frame's queries against frame-0 keys,
+/root/reference/tuneavideo/models/attention.py:296-302) is the framework's
+hw×hw hot op: at 64×64 latents it is a 4096×4096 attention per frame per
+head — materialized, that is ~2 GB of probabilities in bf16 and the single
+reason the reference needs xformers (SURVEY §2.1 #7). Three implementations
+behind one dispatch:
+
+  * **flash** — the Pallas TPU flash-attention kernel
+    (``jax.experimental.pallas.ops.tpu.flash_attention``): online-softmax
+    tiling in VMEM, differentiable via its custom VJP. Used on TPU for the
+    large-N sites whose head dims pad to ≤128 (SD's 64²/32² levels, d=40/80).
+  * **chunked** — exact attention scanned over query blocks with
+    ``jax.checkpoint``, bounding peak memory to one (chunk × N) score block
+    per step on any backend.
+  * **dense** — plain einsum for small sites (16²/8², where the score matrix
+    is tiny and XLA fuses it fine).
+
+These kernels are only for the UNCONTROLLED frame attention. The P2P
+controlled sites (text-cross, temporal) must materialize probabilities for
+editing — they are small (hw×77 and f×f; SURVEY §7 hard-part #2).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "dense_frame_attention",
+    "chunked_frame_attention",
+    "flash_frame_attention",
+    "make_frame_attention_fn",
+]
+
+# shapes: q (B, F, H, N, D); k, v (B, H, N, D) — frame-0 KV shared across F
+FrameAttentionFn = Callable[[jax.Array, jax.Array, jax.Array], jax.Array]
+
+
+def dense_frame_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+    scale = q.shape[-1] ** -0.5
+    sim = jnp.einsum("bfhqd,bhkd->bfhqk", q, k) * scale
+    probs = jax.nn.softmax(sim.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bfhqk,bhkd->bfhqd", probs, v)
+
+
+def chunked_frame_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, *, q_chunk: int = 512
+) -> jax.Array:
+    """Exact attention, scanned over query chunks (peak score memory
+    B·F·H·q_chunk·N instead of B·F·H·N·N); ``jax.checkpoint`` keeps the
+    backward pass at the same bound."""
+    b, f, h, n, d = q.shape
+    if n % q_chunk != 0 or n <= q_chunk:
+        return dense_frame_attention(q, k, v)
+    nc = n // q_chunk
+    qc = jnp.moveaxis(q.reshape(b, f, h, nc, q_chunk, d), 3, 0)  # (nc,B,F,H,C,D)
+
+    @jax.checkpoint
+    def one_chunk(q_blk):
+        scale = d ** -0.5
+        sim = jnp.einsum("bfhqd,bhkd->bfhqk", q_blk, k) * scale
+        probs = jax.nn.softmax(sim.astype(jnp.float32), axis=-1).astype(q.dtype)
+        return jnp.einsum("bfhqk,bhkd->bfhqd", probs, v)
+
+    out = jax.lax.map(one_chunk, qc)  # (nc, B, F, H, C, D)
+    return jnp.moveaxis(out, 0, 3).reshape(b, f, h, n, d)
+
+
+def flash_frame_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+    """Pallas TPU flash attention with the frame axis folded into batch and
+    the shared frame-0 KV broadcast per frame."""
+    from jax.experimental.pallas.ops.tpu.flash_attention import flash_attention
+
+    b, f, h, n, d = q.shape
+    qf = q.reshape(b * f, h, n, d)
+    kf = jnp.broadcast_to(k[:, None], (b, f, h, n, d)).reshape(b * f, h, n, d)
+    vf = jnp.broadcast_to(v[:, None], (b, f, h, n, d)).reshape(b * f, h, n, d)
+    out = flash_attention(qf, kf, vf, sm_scale=d ** -0.5)
+    return out.reshape(b, f, h, n, d)
+
+
+def make_frame_attention_fn(
+    impl: str = "auto",
+    *,
+    min_large_tokens: int = 1024,
+    q_chunk: int = 512,
+) -> Optional[FrameAttentionFn]:
+    """Dispatching frame-attention implementation.
+
+    ``impl``:
+      * "auto"/"dense" — None → the module-inline fused einsum. Measured on
+        v5e, XLA's fused softmax(QKᵀ)V beats the Pallas flash path for SD
+        sizes in the full forward (the flash wrapper's per-layer KV broadcast
+        materialization eats its win), so dense is the inference default.
+      * "chunked" — the TRAINING path: exact attention scanned over query
+        blocks with ``jax.checkpoint``; the backward pass never materializes
+        an N×N probability tensor (dense would need ~2 GB per 64²-site and
+        OOMs a 16 GB chip when combined with gradients).
+      * "flash" — force the Pallas TPU kernel (head dims pad to ≤128;
+        128 < d % 128 ≠ 0 falls back to chunked). Kept for larger-than-SD
+        configs where N² memory dominates even in the forward.
+    """
+    if impl in ("dense", "auto"):
+        return None
+    if impl not in ("flash", "chunked"):
+        raise ValueError(f"unknown frame attention impl: {impl!r}")
+
+    def fn(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+        n, d = q.shape[-2], q.shape[-1]
+        if n < min_large_tokens:
+            return dense_frame_attention(q, k, v)
+        flash_ok = (d <= 128 or d % 128 == 0) and jax.default_backend() == "tpu"
+        if impl == "flash" and flash_ok:
+            return flash_frame_attention(q, k, v)
+        return chunked_frame_attention(q, k, v, q_chunk=q_chunk)
+
+    return fn

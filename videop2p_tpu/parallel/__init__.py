@@ -1,0 +1,28 @@
+"""Mesh, shardings and sequence-parallel collectives (the distributed layer)."""
+
+from videop2p_tpu.parallel.mesh import (
+    AXIS_DATA,
+    AXIS_FRAMES,
+    AXIS_TENSOR,
+    latent_sharding,
+    make_mesh,
+    param_shardings,
+    replicated,
+    shard_array,
+    text_sharding,
+)
+from videop2p_tpu.parallel.ring import ring_attention, ring_attention_sharded
+
+__all__ = [
+    "AXIS_DATA",
+    "AXIS_FRAMES",
+    "AXIS_TENSOR",
+    "latent_sharding",
+    "make_mesh",
+    "param_shardings",
+    "replicated",
+    "shard_array",
+    "text_sharding",
+    "ring_attention",
+    "ring_attention_sharded",
+]

@@ -1,0 +1,104 @@
+"""Device mesh and sharding layout — the framework's "communication backend".
+
+The reference's only distributed machinery is HF Accelerate wrapping
+torch.distributed/NCCL (run_tuning.py:85-88,210-212,322; SURVEY §2.2/§5.8).
+The TPU-native equivalent is declarative: one ``jax.sharding.Mesh`` with named
+axes, ``NamedSharding`` annotations on params/activations, and XLA inserting
+the collectives (psum for the loss-gather parity, all-gathers for frame-0 KV
+broadcast) over ICI/DCN.
+
+Axes:
+  * ``data``   — batch/video axis (the reference's vestigial DDP axis);
+  * ``frames`` — the frame/sequence axis: sequence parallelism for long
+    videos (SURVEY §5.7 — a 32-frame edit across a v5e-8 is a mesh change);
+  * ``tensor`` — reserved for tensor parallelism of attention heads / FF
+    (not needed for SD-1.x parity; used by SDXL-scale configs).
+
+Convention: activations (B, F, h, w, C) shard as P(("data",), ("frames",));
+parameters replicate by default (the UNet is ~1 GB in bf16 — far below one
+chip's HBM) with optional tensor sharding for the big Dense kernels.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = [
+    "AXIS_DATA",
+    "AXIS_FRAMES",
+    "AXIS_TENSOR",
+    "make_mesh",
+    "latent_sharding",
+    "text_sharding",
+    "replicated",
+    "param_shardings",
+    "shard_array",
+]
+
+AXIS_DATA = "data"
+AXIS_FRAMES = "frames"
+AXIS_TENSOR = "tensor"
+
+
+def make_mesh(
+    shape: Tuple[int, ...] = (1, 1, 1),
+    axis_names: Tuple[str, ...] = (AXIS_DATA, AXIS_FRAMES, AXIS_TENSOR),
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Mesh over the available devices; ``shape`` must multiply to the device
+    count. ``make_mesh((1, 8, 1))`` = pure sequence parallelism over 8 chips."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = int(np.prod(shape))
+    if n != len(devices):
+        raise ValueError(f"mesh shape {shape} needs {n} devices, have {len(devices)}")
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, axis_names)
+
+
+def latent_sharding(mesh: Mesh) -> NamedSharding:
+    """(B, F, h, w, C) video/latent tensors: batch over ``data``, frames over
+    ``frames`` (the sequence-parallel axis)."""
+    return NamedSharding(mesh, P(AXIS_DATA, AXIS_FRAMES))
+
+
+def text_sharding(mesh: Mesh) -> NamedSharding:
+    """(B, L, D) text embeddings: batch over ``data``, rest replicated."""
+    return NamedSharding(mesh, P(AXIS_DATA))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def param_shardings(mesh: Mesh, params, *, tensor_parallel: bool = False):
+    """Sharding pytree for the UNet params.
+
+    Default: fully replicated. With ``tensor_parallel``, the attention/FF
+    Dense kernels shard their output features over ``tensor`` (column
+    parallel, (in, out) → P(None, "tensor")) and ``to_out``/``proj_out``
+    kernels shard input features (row parallel, P("tensor", None)) — the
+    Megatron pairing that keeps each attention block to one psum, expressed
+    declaratively and left to XLA/GSPMD to propagate.
+    """
+
+    def spec(path, leaf):
+        if not tensor_parallel or getattr(leaf, "ndim", 0) != 2:
+            return NamedSharding(mesh, P())
+        keys = [str(getattr(p, "key", "")) for p in path]
+        joined = "/".join(keys)
+        if "attn" in joined or "ff" in joined:
+            if any(k in ("to_out", "proj_out") for k in keys):
+                return NamedSharding(mesh, P(AXIS_TENSOR, None))
+            if any(k in ("to_q", "to_k", "to_v", "proj_geglu", "proj_in") for k in keys):
+                return NamedSharding(mesh, P(None, AXIS_TENSOR))
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map_with_path(spec, params)
+
+
+def shard_array(x: jax.Array, sharding: NamedSharding) -> jax.Array:
+    return jax.device_put(x, sharding)

@@ -1,0 +1,96 @@
+"""Ring attention over a sharded sequence axis (flash-style online softmax +
+``ppermute``).
+
+The reference has no sequence parallelism — its "sequence" is the frame axis
+and it relies on architectural sparsity instead (SURVEY §5.7). For long-video
+TPU runs the frame axis shards over the ``frames`` mesh axis, and the dense
+f×f temporal attention (/root/reference/tuneavideo/models/attention.py:262-268)
+becomes a ring pass: each shard holds its local Q block and rotates K/V blocks
+around the ring with ``lax.ppermute``, maintaining flash-attention running
+max/denominator so nothing materializes beyond one block pair per step.
+Communication rides the ICI ring; compute and the next block's transfer
+overlap (XLA schedules the ppermute asynchronously).
+
+``ring_attention`` is the shard_map-level primitive; ``ring_attention_sharded``
+wraps it for callers holding globally-sharded arrays.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["ring_attention", "ring_attention_sharded"]
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Attention where Q/K/V are sharded on their sequence axis.
+
+    Per-shard shapes (inside ``shard_map``): q (..., Sq, D), k/v (..., Sk, D)
+    with the global sequence split over ``axis_name``. Returns the local
+    output block (..., Sq, D). Numerically identical to softmax(QKᵀ·scale)V
+    over the gathered sequence (online-softmax rescaling is exact).
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    n = jax.lax.psum(1, axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    q32 = q.astype(jnp.float32)
+    m0 = jnp.full(q.shape[:-1], -jnp.inf, jnp.float32)
+    l0 = jnp.zeros(q.shape[:-1], jnp.float32)
+    o0 = jnp.zeros(q32.shape, jnp.float32)
+
+    def body(carry, _):
+        k_blk, v_blk, m, l, o = carry
+        s = jnp.einsum("...qd,...kd->...qk", q32, k_blk.astype(jnp.float32)) * scale
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l = l * corr + p.sum(axis=-1)
+        o = o * corr[..., None] + jnp.einsum(
+            "...qk,...kd->...qd", p, v_blk.astype(jnp.float32)
+        )
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (k_blk, v_blk, m_new, l, o), None
+
+    (k_fin, v_fin, m, l, o), _ = jax.lax.scan(
+        body, (k, v, m0, l0, o0), None, length=n
+    )
+    del k_fin, v_fin
+    return (o / l[..., None]).astype(q.dtype)
+
+
+def ring_attention_sharded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    axis_name: str = "frames",
+    seq_axis: int = -2,
+) -> jax.Array:
+    """shard_map wrapper: q/k/v are global arrays whose ``seq_axis`` is (or
+    will be) sharded over ``axis_name``; batch-like leading axes replicate."""
+    ndim = q.ndim
+    seq_axis = seq_axis % ndim
+    spec_parts = [None] * ndim
+    spec_parts[seq_axis] = axis_name
+    spec = P(*spec_parts)
+
+    fn = functools.partial(ring_attention, axis_name=axis_name)
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
+    )(q, k, v)

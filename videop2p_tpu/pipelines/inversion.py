@@ -96,6 +96,9 @@ def null_text_optimization(
     guidance_scale: float = 7.5,
     num_inner_steps: int = 10,
     epsilon: float = 1e-5,
+    dependent_weight: float = 0.0,
+    dependent_sampler: Optional[DependentNoiseSampler] = None,
+    key: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Optimize a per-step unconditional embedding that makes CFG denoising
     replay the recorded inversion trajectory (run_videop2p.py:580-612).
@@ -104,57 +107,80 @@ def null_text_optimization(
     ``cond_embedding`` / ``uncond_embedding``: (B, L, D).
     Returns per-step uncond embeddings (num_steps, B, L, D) to feed
     ``edit_sample``'s injection seam.
+
+    In dependent mode every single prediction gets the same AR-noise blend
+    the inversion used — ``ε = (1-w)·ε̂ + w·ar_noise`` with a FRESH draw per
+    call (the reference's ``get_noise_pred_single``/``get_noise_pred``,
+    run_videop2p.py:465-487; gradients flow through the ``(1-w)·ε̂`` term
+    only) — so the objective matches the model that produced the trajectory.
     """
+    if dependent_weight > 0.0 and dependent_sampler is None:
+        raise ValueError("dependent_weight > 0 requires dependent_sampler")
+    if key is None:
+        key = jax.random.key(0)
     timesteps = jnp.asarray(scheduler.timesteps(num_inference_steps))
     # latent_prev for outer step i is trajectory[num - i - 1]
     # (the reference's latents[len - i - 2], run_videop2p.py:585)
     prev_seq = trajectory[::-1][1:]
     steps = jnp.arange(num_inference_steps)
-    lr_seq = 1e-2 * (1.0 - steps / 100.0)  # run_videop2p.py:588
+    # run_videop2p.py:588 — clamped at 0 so step counts > 100 (the reference
+    # hardcodes 50) cannot flip the update into gradient ascent
+    lr_seq = jnp.maximum(1e-2 * (1.0 - steps / 100.0), 0.0)
     thresh_seq = epsilon + steps * 2e-5  # run_videop2p.py:603
     # Adam direction with unit lr; the decayed per-step lr scales the update
     adam = optax.adam(1.0)
 
-    def cond_pred(latent, t):
-        eps, _ = unet_fn(params, latent, t, cond_embedding, None)
-        return eps
+    def blend(eps, key):
+        if dependent_weight <= 0.0:
+            return eps
+        ar_noise = dependent_sampler.sample_like(key, eps)
+        return (1.0 - dependent_weight) * eps + dependent_weight * ar_noise
 
     def outer(carry, xs):
-        latent_cur, uncond = carry
+        latent_cur, uncond, key = carry
         t, latent_prev, lr, thresh = xs
-        eps_cond = jax.lax.stop_gradient(cond_pred(latent_cur, t))
+        key, k_cond, k_fu, k_fc = jax.random.split(key, 4)
+        eps, _ = unet_fn(params, latent_cur, t, cond_embedding, None)
+        eps_cond_raw = jax.lax.stop_gradient(eps)
+        eps_cond = blend(eps_cond_raw, k_cond)
 
-        def loss_fn(u):
+        def loss_fn(u, k):
             eps_uncond, _ = unet_fn(params, latent_cur, t, u, None)
+            eps_uncond = blend(eps_uncond, k)
             eps = eps_uncond + guidance_scale * (eps_cond - eps_uncond)
             prev_rec = scheduler.prev_step(eps, t, latent_cur, num_inference_steps)
             return jnp.mean((prev_rec - latent_prev) ** 2)
 
         def inner_cond(state):
-            _, _, last_loss, j = state
+            _, _, last_loss, j, _ = state
             return jnp.logical_and(j < num_inner_steps, last_loss >= thresh)
 
         def inner_body(state):
-            u, opt_state, _, j = state
-            loss, grads = jax.value_and_grad(loss_fn)(u)
+            u, opt_state, _, j, k = state
+            k, sub = jax.random.split(k)
+            loss, grads = jax.value_and_grad(loss_fn)(u, sub)
             updates, opt_state = adam.update(grads, opt_state, u)
             u = optax.apply_updates(u, jax.tree.map(lambda g: lr * g, updates))
-            return (u, opt_state, loss, j + 1)
+            return (u, opt_state, loss, j + 1, k)
 
         opt_state = adam.init(uncond)
-        uncond, _, _, _ = jax.lax.while_loop(
-            inner_cond, inner_body, (uncond, opt_state, jnp.inf, 0)
+        uncond, _, _, _, key = jax.lax.while_loop(
+            inner_cond, inner_body, (uncond, opt_state, jnp.inf, 0, key)
         )
 
-        # advance with the optimized embedding under full CFG
-        # (run_videop2p.py:606-610)
+        # advance with the optimized embedding under full CFG; the reference
+        # blends the batched (2B) prediction with one batched draw — i.e.
+        # independent fresh noise per half (run_videop2p.py:474-487,606-610);
+        # the cond prediction is deterministic so its raw value is reused
         eps_uncond, _ = unet_fn(params, latent_cur, t, uncond, None)
-        eps = eps_uncond + guidance_scale * (eps_cond - eps_uncond)
+        eps_uncond = blend(eps_uncond, k_fu)
+        eps_c = blend(eps_cond_raw, k_fc)
+        eps = eps_uncond + guidance_scale * (eps_c - eps_uncond)
         latent_cur = scheduler.prev_step(eps, t, latent_cur, num_inference_steps)
-        return (latent_cur, uncond), uncond
+        return (latent_cur, uncond, key), uncond
 
     x_t = trajectory[-1]
-    (_, _), uncond_seq = jax.lax.scan(
-        outer, (x_t, uncond_embedding), (timesteps, prev_seq, lr_seq, thresh_seq)
+    (_, _, _), uncond_seq = jax.lax.scan(
+        outer, (x_t, uncond_embedding, key), (timesteps, prev_seq, lr_seq, thresh_seq)
     )
     return uncond_seq

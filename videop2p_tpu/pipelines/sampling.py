@@ -74,6 +74,7 @@ def edit_sample(
     key: Optional[jax.Array] = None,
     dependent_sampler: Optional[DependentNoiseSampler] = None,
     blend_res: Optional[Tuple[int, int]] = None,
+    null_uncond_embeddings: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Run the controlled denoise loop; returns final latents (P, F, h, w, C).
 
@@ -81,8 +82,13 @@ def edit_sample(
     latent is expanded so source & edit share x_T (the reference's
     ``prepare_latents`` expansion, pipeline_tuneavideo.py:312-314).
     ``cond_embeddings``: (P, L, D) text embeddings, source prompt first.
-    ``uncond_embeddings``: (L, D) static, or (num_steps, L, D) per-step
-    (null-text inversion output, injected per step).
+    ``uncond_embeddings``: (L, D) or (1, L, D) — the raw encoder uncond used
+    by every stream.
+    ``null_uncond_embeddings``: optional per-step null-text optimization
+    output, (num_steps, L, D) or (num_steps, 1, L, D) — injected into the
+    SOURCE stream's uncond slot only each step; the edit streams keep the raw
+    uncond (the reference's ``text_embeddings[0] = uncond_embeddings_pre[i]``,
+    pipeline_tuneavideo.py:399-403).
     ``source_uses_cfg=False`` is the --fast mode source branch.
     """
     P = cond_embeddings.shape[0]
@@ -98,37 +104,47 @@ def edit_sample(
     text_len = cond_embeddings.shape[1]
 
     timesteps = jnp.asarray(scheduler.timesteps(num_inference_steps))
-    # accepted shapes: (L, D) or (1, L, D) static; (num_steps, L, D) or
-    # (num_steps, 1, L, D) per-step (null_text_optimization output, injected
-    # per step and shared across prompt streams — run_videop2p.py:399-403)
-    if uncond_embeddings.ndim == 4:
-        if uncond_embeddings.shape[1] != 1:
-            raise ValueError(
-                "per-step uncond embeddings must be optimized on the batch-1 "
-                f"source stream, got shape {uncond_embeddings.shape}"
-            )
-        uncond_embeddings = uncond_embeddings[:, 0]
-    elif uncond_embeddings.ndim == 3 and uncond_embeddings.shape[0] == 1:
-        # a batched text-encoder output (1, L, D), not a per-step sequence
+    if uncond_embeddings.ndim == 3 and uncond_embeddings.shape[0] == 1:
         uncond_embeddings = uncond_embeddings[0]
-    if uncond_embeddings.ndim == 2:
-        uncond_seq = jnp.broadcast_to(
-            uncond_embeddings[None], (num_inference_steps,) + uncond_embeddings.shape
-        )
-    elif uncond_embeddings.ndim == 3 and uncond_embeddings.shape[0] == num_inference_steps:
-        uncond_seq = uncond_embeddings
-    else:
+    if uncond_embeddings.ndim != 2:
         raise ValueError(
-            f"per-step uncond embeddings must have leading dim {num_inference_steps}, "
-            f"got {uncond_embeddings.shape}"
+            f"uncond_embeddings must be (L, D) or (1, L, D), got "
+            f"{uncond_embeddings.shape}; per-step null-text embeddings go in "
+            "null_uncond_embeddings"
+        )
+    # the source stream's per-step uncond: the null-text sequence when given,
+    # else the raw uncond every step
+    if null_uncond_embeddings is not None:
+        if null_uncond_embeddings.ndim == 4:
+            if null_uncond_embeddings.shape[1] != 1:
+                raise ValueError(
+                    "null-text embeddings must be optimized on the batch-1 "
+                    f"source stream, got shape {null_uncond_embeddings.shape}"
+                )
+            null_uncond_embeddings = null_uncond_embeddings[:, 0]
+        if (
+            null_uncond_embeddings.ndim != 3
+            or null_uncond_embeddings.shape[0] != num_inference_steps
+        ):
+            raise ValueError(
+                f"null-text embeddings must have leading dim {num_inference_steps}, "
+                f"got {null_uncond_embeddings.shape}"
+            )
+        uncond0_seq = null_uncond_embeddings
+    else:
+        uncond0_seq = jnp.broadcast_to(
+            uncond_embeddings[None], (num_inference_steps,) + uncond_embeddings.shape
         )
 
     if key is None:
         key = jax.random.key(0)
     use_blend = ctx is not None and ctx.blend is not None
 
-    def step_text(uncond):
-        u = jnp.broadcast_to(uncond[None], (P,) + uncond.shape)
+    def step_text(uncond0):
+        # stream 0's uncond is per-step (null-text seam); edit streams keep
+        # the raw uncond (pipeline_tuneavideo.py:399-403)
+        u = jnp.broadcast_to(uncond_embeddings[None], (P,) + uncond_embeddings.shape)
+        u = jnp.concatenate([uncond0[None], u[1:]], axis=0)
         return jnp.concatenate([u, cond_embeddings], axis=0)
 
     maps_sum = None
@@ -140,7 +156,7 @@ def edit_sample(
             params,
             jnp.concatenate([latents, latents], axis=0),
             timesteps[0],
-            step_text(uncond_seq[0]),
+            step_text(uncond0_seq[0]),
             control0,
         )
         maps_shape = jax.eval_shape(
@@ -192,6 +208,6 @@ def edit_sample(
             latents = local_blend(latents, maps_sum, ctx.blend, i)
         return (latents, maps_sum, key), None
 
-    xs = (timesteps, jnp.arange(num_inference_steps), uncond_seq)
+    xs = (timesteps, jnp.arange(num_inference_steps), uncond0_seq)
     (latents, _, _), _ = jax.lax.scan(body, (latents, maps_sum, key), xs)
     return latents

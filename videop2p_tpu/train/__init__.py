@@ -1,0 +1,37 @@
+"""Stage-1 one-shot tuning: masked optimizer, train step, checkpointing."""
+
+from videop2p_tpu.train.checkpoint import (
+    latest_checkpoint,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from videop2p_tpu.train.masking import (
+    DEFAULT_TRAINABLE,
+    count_params,
+    merge_params,
+    partition_params,
+    trainable_mask,
+)
+from videop2p_tpu.train.tuner import (
+    TrainState,
+    TuneConfig,
+    make_lr_schedule,
+    make_optimizer,
+    train_step,
+)
+
+__all__ = [
+    "latest_checkpoint",
+    "restore_checkpoint",
+    "save_checkpoint",
+    "DEFAULT_TRAINABLE",
+    "count_params",
+    "merge_params",
+    "partition_params",
+    "trainable_mask",
+    "TrainState",
+    "TuneConfig",
+    "make_lr_schedule",
+    "make_optimizer",
+    "train_step",
+]

@@ -1,0 +1,56 @@
+"""Checkpoint/resume via orbax.
+
+The reference keeps three artifact kinds (SURVEY §5.4): Accelerate training
+state ``checkpoint-{step}`` dirs (run_tuning.py:340-344), the final diffusers
+pipeline dir (:387-393), and inverted latents. Here training state
+(params/opt_state/step) goes through orbax; the diffusers-layout export for
+Stage-1→Stage-2 interop lives in :mod:`videop2p_tpu.models.convert`.
+``latest_checkpoint`` mirrors the reference's "latest" resume rule — highest
+``checkpoint-*`` suffix (run_tuning.py:250-264).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Optional
+
+import jax
+
+__all__ = ["save_checkpoint", "restore_checkpoint", "latest_checkpoint"]
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.StandardCheckpointer()
+
+
+def save_checkpoint(output_dir: str, state: Any, step: int) -> str:
+    """Write ``<output_dir>/checkpoint-<step>`` (run_tuning.py:340-344)."""
+    path = os.path.join(os.path.abspath(output_dir), f"checkpoint-{step}")
+    ckptr = _checkpointer()
+    ckptr.save(path, state, force=True)
+    ckptr.wait_until_finished()
+    return path
+
+
+def restore_checkpoint(path: str, target: Any) -> Any:
+    """Restore a pytree with the structure/sharding of ``target``."""
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype) if hasattr(x, "shape") else x,
+        target,
+    )
+    return _checkpointer().restore(os.path.abspath(path), abstract)
+
+
+def latest_checkpoint(output_dir: str) -> Optional[str]:
+    """Highest-numbered ``checkpoint-*`` dir, or None (run_tuning.py:252-258)."""
+    if not os.path.isdir(output_dir):
+        return None
+    best, best_step = None, -1
+    for name in os.listdir(output_dir):
+        m = re.fullmatch(r"checkpoint-(\d+)", name)
+        if m and int(m.group(1)) > best_step:
+            best, best_step = name, int(m.group(1))
+    return os.path.join(output_dir, best) if best else None

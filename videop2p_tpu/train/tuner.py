@@ -1,0 +1,196 @@
+"""Stage-1 one-shot tuning: optimizer, train state and the pure train step.
+
+TPU-native re-design of the reference trainer
+(/root/reference/run_tuning.py:44-395). The torch/Accelerate loop becomes a
+pure jittable ``train_step`` over an explicit :class:`TrainState`:
+
+  * partitioned AdamW — only ``attn1.to_q / attn2.to_q / attn_temp`` are in
+    the differentiated/optimized subtree (run_tuning.py:137-141,157-176);
+    the frozen ~90% of the UNet never materializes gradients or moments;
+  * gradient clipping (run_tuning.py:328) and accumulation
+    (``optax.MultiSteps``, the reference's ``accelerator.accumulate``);
+  * iid or temporally-dependent training noise (run_tuning.py:290-294);
+  * one random timestep per video (run_tuning.py:298), ε- or v-target
+    (run_tuning.py:310-315), MSE in float32 (run_tuning.py:318-319);
+  * lr schedules by name mirroring diffusers ``get_scheduler``
+    (run_tuning.py:202-207).
+
+The step is mesh-agnostic: under ``jit`` with sharded inputs the same code is
+the distributed trainer (collectives are compiler-inserted; loss averaging is
+the implicit psum the reference does explicitly via ``accelerator.gather``,
+run_tuning.py:322).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+from videop2p_tpu.core.ddpm import DDPMScheduler
+from videop2p_tpu.core.noise import DependentNoiseSampler
+from videop2p_tpu.pipelines.sampling import UNetFn
+from videop2p_tpu.train.masking import (
+    DEFAULT_TRAINABLE,
+    merge_params,
+    partition_params,
+)
+
+__all__ = ["TuneConfig", "TrainState", "make_optimizer", "make_lr_schedule", "train_step"]
+
+
+@dataclasses.dataclass(frozen=True)
+class TuneConfig:
+    """Training hyperparameters (reference defaults: run_tuning.py:44-83,
+    configs/rabbit-jump-tune.yaml:24-38)."""
+
+    learning_rate: float = 3e-5
+    scale_lr: bool = False
+    lr_scheduler: str = "constant"
+    lr_warmup_steps: int = 0
+    max_train_steps: int = 500
+    adam_beta1: float = 0.9
+    adam_beta2: float = 0.999
+    adam_weight_decay: float = 1e-2
+    adam_epsilon: float = 1e-8
+    max_grad_norm: float = 1.0
+    gradient_accumulation_steps: int = 1
+    trainable_modules: Tuple[str, ...] = DEFAULT_TRAINABLE
+    train_batch_size: int = 1
+    num_processes: int = 1  # for scale_lr parity (run_tuning.py:152-155)
+
+
+def make_lr_schedule(cfg: TuneConfig) -> optax.Schedule:
+    """Diffusers-style schedules by name (run_tuning.py:202-207)."""
+    lr = cfg.learning_rate
+    if cfg.scale_lr:
+        # run_tuning.py:152-155
+        lr = lr * cfg.gradient_accumulation_steps * cfg.train_batch_size * cfg.num_processes
+    total = max(cfg.max_train_steps, 1)
+    warmup = cfg.lr_warmup_steps
+    if cfg.lr_scheduler == "constant":
+        base = optax.constant_schedule(lr)
+    elif cfg.lr_scheduler == "constant_with_warmup":
+        return optax.join_schedules(
+            [optax.linear_schedule(0.0, lr, max(warmup, 1)), optax.constant_schedule(lr)],
+            [warmup],
+        )
+    elif cfg.lr_scheduler == "linear":
+        return optax.join_schedules(
+            [
+                optax.linear_schedule(0.0, lr, max(warmup, 1)),
+                optax.linear_schedule(lr, 0.0, max(total - warmup, 1)),
+            ],
+            [warmup],
+        )
+    elif cfg.lr_scheduler == "cosine":
+        return optax.join_schedules(
+            [
+                optax.linear_schedule(0.0, lr, max(warmup, 1)),
+                optax.cosine_decay_schedule(lr, max(total - warmup, 1)),
+            ],
+            [warmup],
+        )
+    else:
+        raise ValueError(f"unknown lr_scheduler: {cfg.lr_scheduler!r}")
+    return base
+
+
+def make_optimizer(cfg: TuneConfig) -> optax.GradientTransformation:
+    """Clipped, accumulating AdamW — applied to the trainable subtree only
+    (freezing is by partition, not masking: see masking.partition_params)."""
+    tx = optax.chain(
+        optax.clip_by_global_norm(cfg.max_grad_norm),
+        optax.adamw(
+            learning_rate=make_lr_schedule(cfg),
+            b1=cfg.adam_beta1,
+            b2=cfg.adam_beta2,
+            eps=cfg.adam_epsilon,
+            weight_decay=cfg.adam_weight_decay,
+        ),
+    )
+    if cfg.gradient_accumulation_steps > 1:
+        tx = optax.MultiSteps(tx, every_k_schedule=cfg.gradient_accumulation_steps)
+    return tx
+
+
+class TrainState(struct.PyTreeNode):
+    """Trainable/frozen split train state. ``trainable`` ∪ ``frozen`` is the
+    UNet's full "params" collection (masking.merge_params)."""
+
+    step: jax.Array
+    trainable: Any
+    frozen: Any
+    opt_state: Any
+
+    @classmethod
+    def create(
+        cls,
+        params: Any,
+        tx: optax.GradientTransformation,
+        trainable_modules: Sequence[str] = DEFAULT_TRAINABLE,
+    ) -> "TrainState":
+        trainable, frozen = partition_params(params, trainable_modules)
+        return cls(
+            step=jnp.asarray(0),
+            trainable=trainable,
+            frozen=frozen,
+            opt_state=tx.init(trainable),
+        )
+
+    @property
+    def params(self) -> Any:
+        """The merged full parameter tree (for validation/export)."""
+        return merge_params(self.trainable, self.frozen)
+
+
+def train_step(
+    unet_fn: UNetFn,
+    tx: optax.GradientTransformation,
+    state: TrainState,
+    scheduler: DDPMScheduler,
+    latents: jax.Array,
+    text_embeddings: jax.Array,
+    key: jax.Array,
+    *,
+    dependent_sampler: Optional[DependentNoiseSampler] = None,
+) -> Tuple[TrainState, jax.Array]:
+    """One tuning step on VAE-encoded latents (run_tuning.py:280-331).
+
+    ``latents``: (B, F, h, w, C) clean latents (already ×0.18215);
+    ``text_embeddings``: (B, L, D). Returns (new_state, loss).
+    """
+    noise_key, t_key = jax.random.split(key)
+    if dependent_sampler is not None:
+        noise = dependent_sampler.sample_like(noise_key, latents)
+    else:
+        noise = jax.random.normal(noise_key, latents.shape, latents.dtype)
+    timesteps = jax.random.randint(
+        t_key, (latents.shape[0],), 0, scheduler.num_train_timesteps
+    )
+    noisy = scheduler.add_noise(latents, noise, timesteps)
+    target = scheduler.training_target(latents, noise, timesteps)
+
+    def loss_fn(trainable):
+        # differentiate only the trainable subtree; unet_fn takes the full
+        # variables dict
+        params = merge_params(trainable, state.frozen)
+        pred, _ = unet_fn({"params": params}, noisy, timesteps, text_embeddings, None)
+        return jnp.mean((pred.astype(jnp.float32) - target.astype(jnp.float32)) ** 2)
+
+    loss, grads = jax.value_and_grad(loss_fn)(state.trainable)
+    updates, opt_state = tx.update(grads, state.opt_state, state.trainable)
+    trainable = optax.apply_updates(state.trainable, updates)
+    return (
+        TrainState(
+            step=state.step + 1,
+            trainable=trainable,
+            frozen=state.frozen,
+            opt_state=opt_state,
+        ),
+        loss,
+    )
