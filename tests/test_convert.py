@@ -1,0 +1,202 @@
+"""Weight-converter tests.
+
+The strongest check available offline: the CLIP importer is validated
+NUMERICALLY against the real transformers torch model (same random weights →
+same hidden states). The UNet mapping is validated by round-trip
+(flax → torch-layout → flax is the identity) plus the temporal-keep-init
+inflation rule; the VAE by round-trip through its own exporter-free path
+(synthetic torch dict built from the inverse name map).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from videop2p_tpu.models import UNet3DConditionModel, UNet3DConfig
+from videop2p_tpu.models.clip import CLIPTextConfig, CLIPTextEncoder
+from videop2p_tpu.models.convert import (
+    clip_params_from_torch,
+    unet3d_params_from_torch,
+    unet3d_params_to_torch,
+    vae_params_from_torch,
+)
+from videop2p_tpu.models.vae import AutoencoderKL, VAEConfig
+
+
+def test_clip_matches_transformers_torch():
+    """Import random torch CLIPTextModel weights; flax forward must equal the
+    torch forward to float tolerance."""
+    import torch
+    from transformers import CLIPTextConfig as HFConfig, CLIPTextModel
+
+    hf_cfg = HFConfig(
+        vocab_size=128, hidden_size=16, intermediate_size=32,
+        num_hidden_layers=2, num_attention_heads=2, max_position_embeddings=77,
+        hidden_act="quick_gelu",
+    )
+    torch_model = CLIPTextModel(hf_cfg).eval()
+    sd = {k: v.detach().numpy() for k, v in torch_model.state_dict().items()}
+
+    cfg = CLIPTextConfig.tiny()
+    model = CLIPTextEncoder(config=cfg)
+    ids = np.array([[49, 3, 7, 12, 99] + [100] * 72], dtype=np.int32) % 128
+    abstract = jax.eval_shape(
+        lambda: model.init(jax.random.key(0), jnp.asarray(ids))
+    )["params"]
+    params = clip_params_from_torch(sd, abstract)
+
+    out_flax = model.apply({"params": params}, jnp.asarray(ids))
+    with torch.no_grad():
+        out_torch = torch_model(torch.tensor(ids, dtype=torch.long)).last_hidden_state
+    np.testing.assert_allclose(
+        np.asarray(out_flax), out_torch.numpy(), atol=2e-5
+    )
+
+
+@pytest.fixture(scope="module")
+def tiny_unet_params():
+    cfg = UNet3DConfig.tiny()
+    model = UNet3DConditionModel(config=cfg)
+    sample = jax.random.normal(jax.random.key(0), (1, 2, 8, 8, 4))
+    text = jax.random.normal(jax.random.key(1), (1, 7, cfg.cross_attention_dim))
+    variables = jax.jit(model.init)(jax.random.key(2), sample, jnp.asarray(0), text)
+    return cfg, model, dict(variables)["params"], sample, text
+
+
+def test_unet_roundtrip_identity(tiny_unet_params):
+    cfg, model, params, _, _ = tiny_unet_params
+    sd = unet3d_params_to_torch(params)
+    # all torch keys use diffusers-style dotted names
+    assert any(k.startswith("down_blocks.0.attentions.0.transformer_blocks.0.attn1.to_q") for k in sd)
+    assert any("ff.net.0.proj" in k for k in sd)
+    assert any("ff.net.2" in k for k in sd)
+    assert any("time_embedding.linear_1" in k for k in sd)
+    assert any("attn_temp" in k for k in sd)  # 3-D export keeps temporal keys
+    restored, report = unet3d_params_from_torch(sd, params)
+    assert report["kept_init"] == [] and report["unused"] == []
+    for (pa, a), (pb, b) in zip(
+        jax.tree_util.tree_flatten_with_path(params)[0],
+        jax.tree_util.tree_flatten_with_path(restored)[0],
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=str(pa))
+
+
+def test_unet_2d_inflation_keeps_temporal_init(tiny_unet_params):
+    """Dropping the temporal keys from the state dict (= a genuine 2-D SD
+    checkpoint) must keep fresh inits exactly for attn_temp/norm_temp
+    (unet.py:446-448) and load everything else."""
+    cfg, model, params, sample, text = tiny_unet_params
+    sd = unet3d_params_to_torch(params)
+    sd_2d = {k: v for k, v in sd.items() if "attn_temp" not in k and "norm_temp" not in k}
+    # perturb all 2-D weights so "loaded" is distinguishable from "kept"
+    sd_2d = {k: v + 1.0 for k, v in sd_2d.items()}
+    restored, report = unet3d_params_from_torch(sd_2d, params)
+    assert len(report["kept_init"]) > 0
+    assert all("attn_temp" in p or "norm_temp" in p for p in report["kept_init"])
+    flat_orig = dict(jax.tree_util.tree_flatten_with_path(params)[0])
+    for path, leaf in jax.tree_util.tree_flatten_with_path(restored)[0]:
+        p = jax.tree_util.keystr(path)
+        orig = np.asarray(flat_orig[path])
+        if "attn_temp" in p or "norm_temp" in p:
+            np.testing.assert_array_equal(np.asarray(leaf), orig, err_msg=p)
+        else:
+            assert not np.allclose(np.asarray(leaf), orig), p
+
+
+def test_unet_missing_key_raises(tiny_unet_params):
+    cfg, model, params, _, _ = tiny_unet_params
+    sd = unet3d_params_to_torch(params)
+    del sd["conv_in.weight"]
+    with pytest.raises(KeyError, match="conv_in"):
+        unet3d_params_from_torch(sd, params)
+
+
+def test_vae_import_both_attention_namings():
+    cfg = VAEConfig.tiny()
+    model = AutoencoderKL(config=cfg)
+    x = jax.random.normal(jax.random.key(0), (2, 16, 16, 3))
+    variables = jax.jit(model.init)(jax.random.key(1), x, jax.random.key(2))
+    params = dict(variables)["params"]
+
+    # build a synthetic torch dict via the inverse of the importer's name map
+    from videop2p_tpu.models.convert import _vae_flax_to_torch
+    from flax import traverse_util
+
+    flat = traverse_util.flatten_dict(params)
+    sd = {}
+    for path, leaf in flat.items():
+        key, kind = _vae_flax_to_torch(path)
+        arr = np.asarray(leaf)
+        if arr.ndim == 4:
+            arr = np.transpose(arr, (3, 2, 0, 1))
+        elif kind == "dense" and arr.ndim == 2:
+            arr = np.transpose(arr)
+        sd[key] = arr
+    restored = vae_params_from_torch(sd, params)
+    for (pa, a), (_, b) in zip(
+        jax.tree_util.tree_flatten_with_path(params)[0],
+        jax.tree_util.tree_flatten_with_path(restored)[0],
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=str(pa))
+
+    # 0.11-era attention names (query/key/value/proj_attn) also accepted
+    sd_old = {}
+    for k, v in sd.items():
+        k = (
+            k.replace(".to_q.", ".query.")
+            .replace(".to_k.", ".key.")
+            .replace(".to_v.", ".value.")
+            .replace(".to_out.0.", ".proj_attn.")
+        )
+        sd_old[k] = v
+    restored_old = vae_params_from_torch(sd_old, params)
+    for (pa, a), (_, b) in zip(
+        jax.tree_util.tree_flatten_with_path(restored)[0],
+        jax.tree_util.tree_flatten_with_path(restored_old)[0],
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=str(pa))
+
+
+def test_vae_encode_decode_shapes():
+    from videop2p_tpu.models.vae import decode_video, encode_video
+
+    cfg = VAEConfig.tiny()
+    model = AutoencoderKL(config=cfg)
+    video = jax.random.uniform(jax.random.key(0), (1, 3, 16, 16, 3)) * 2 - 1
+    variables = jax.jit(model.init)(
+        jax.random.key(1), video[:, 0], jax.random.key(2)
+    )
+    z = encode_video(model, variables, video, jax.random.key(3))
+    assert z.shape == (1, 3, 8, 8, cfg.latent_channels)  # one downsample level
+    z_mean = encode_video(model, variables, video, jax.random.key(4), sample=False)
+    z_mean2 = encode_video(model, variables, video, jax.random.key(5), sample=False)
+    np.testing.assert_array_equal(np.asarray(z_mean), np.asarray(z_mean2))
+    out = decode_video(model, variables, z, chunk=2)
+    assert out.shape == video.shape
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_pipeline_dir_roundtrip(tmp_path, tiny_unet_params):
+    """save_pipeline -> load_pipeline reproduces the UNet params and config
+    (the reference's save_pretrained / from_pretrained contract,
+    run_tuning.py:387-393, run_videop2p.py:101-114)."""
+    from videop2p_tpu.models.pipeline_io import load_pipeline, save_pipeline
+
+    cfg, model, params, sample, text = tiny_unet_params
+    out = str(tmp_path / "ckpt")
+    save_pipeline(out, cfg, {"params": params},
+                  scheduler_config={"beta_schedule": "scaled_linear"})
+    loaded = load_pipeline(out, load_vae=False, load_text_encoder=False,
+                           frame_attention=cfg.frame_attention)
+    assert loaded.unet.config.block_out_channels == cfg.block_out_channels
+    assert loaded.inflation_report["kept_init"] == []  # 3-D ckpt: all loaded
+    for (pa, a), (_, b) in zip(
+        jax.tree_util.tree_flatten_with_path(params)[0],
+        jax.tree_util.tree_flatten_with_path(loaded.unet_params["params"])[0],
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), err_msg=str(pa))
+    # and the loaded model runs
+    out_arr = loaded.unet.apply(loaded.unet_params, sample, jnp.asarray(3), text)
+    ref_arr = model.apply({"params": params}, sample, jnp.asarray(3), text)
+    np.testing.assert_allclose(np.asarray(out_arr), np.asarray(ref_arr), atol=1e-5)

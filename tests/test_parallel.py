@@ -15,7 +15,6 @@ from videop2p_tpu.parallel import (
     AXIS_FRAMES,
     latent_sharding,
     make_mesh,
-    make_mesh as _mm,
     param_shardings,
     replicated,
     ring_attention_sharded,

@@ -15,7 +15,9 @@ from videop2p_tpu.models.layers import (
     Upsample3D,
     get_timestep_embedding,
 )
+from videop2p_tpu.models.clip import CLIPTextConfig, CLIPTextEncoder
 from videop2p_tpu.models.unet import UNet3DConditionModel, UNet3DConfig
+from videop2p_tpu.models.vae import AutoencoderKL, VAEConfig, decode_video, encode_video
 
 __all__ = [
     "AttnControl",
@@ -31,4 +33,10 @@ __all__ = [
     "get_timestep_embedding",
     "UNet3DConditionModel",
     "UNet3DConfig",
+    "CLIPTextConfig",
+    "CLIPTextEncoder",
+    "AutoencoderKL",
+    "VAEConfig",
+    "decode_video",
+    "encode_video",
 ]

@@ -1,0 +1,113 @@
+"""CLIP text encoder in flax (the SD-1.x conditioning model).
+
+The reference consumes ``transformers.CLIPTextModel`` as a frozen dependency
+(/root/reference/run_tuning.py:129, run_videop2p.py:104-107). This is a
+from-scratch linen implementation of the same architecture — learned token +
+position embeddings, pre-LN transformer with causal masking and QuickGELU,
+final LayerNorm — returning the last hidden state (B, 77, 768) the UNet
+cross-attends to. Weight import from a transformers checkpoint lives in
+:mod:`videop2p_tpu.models.convert` and is validated numerically against the
+torch model in tests/test_convert.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+__all__ = ["CLIPTextConfig", "CLIPTextEncoder"]
+
+Dtype = jnp.dtype
+
+
+@dataclasses.dataclass(frozen=True)
+class CLIPTextConfig:
+    vocab_size: int = 49408
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    max_position_embeddings: int = 77
+    layer_norm_eps: float = 1e-5
+
+    @classmethod
+    def tiny(cls, **overrides) -> "CLIPTextConfig":
+        cfg = dict(
+            vocab_size=128, hidden_size=16, intermediate_size=32,
+            num_hidden_layers=2, num_attention_heads=2, max_position_embeddings=77,
+        )
+        cfg.update(overrides)
+        return cls(**cfg)
+
+
+def quick_gelu(x: jax.Array) -> jax.Array:
+    return x * jax.nn.sigmoid(1.702 * x)
+
+
+class _CLIPAttention(nn.Module):
+    config: CLIPTextConfig
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, mask: jax.Array) -> jax.Array:
+        cfg = self.config
+        h = cfg.num_attention_heads
+        d = cfg.hidden_size // h
+        b, n, _ = x.shape
+        q = nn.Dense(cfg.hidden_size, dtype=self.dtype, name="q_proj")(x) * (d ** -0.5)
+        k = nn.Dense(cfg.hidden_size, dtype=self.dtype, name="k_proj")(x)
+        v = nn.Dense(cfg.hidden_size, dtype=self.dtype, name="v_proj")(x)
+        q, k, v = (t.reshape(b, n, h, d).transpose(0, 2, 1, 3) for t in (q, k, v))
+        sim = jnp.einsum("bhqd,bhkd->bhqk", q, k) + mask
+        probs = jax.nn.softmax(sim.astype(jnp.float32), axis=-1).astype(self.dtype)
+        out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+        out = out.transpose(0, 2, 1, 3).reshape(b, n, cfg.hidden_size)
+        return nn.Dense(cfg.hidden_size, dtype=self.dtype, name="out_proj")(out)
+
+
+class _CLIPLayer(nn.Module):
+    config: CLIPTextConfig
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, mask: jax.Array) -> jax.Array:
+        cfg = self.config
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype, name="layer_norm1")(x)
+        x = x + _CLIPAttention(cfg, self.dtype, name="self_attn")(h, mask)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=self.dtype, name="layer_norm2")(x)
+        h = nn.Dense(cfg.intermediate_size, dtype=self.dtype, name="fc1")(h)
+        h = quick_gelu(h)
+        h = nn.Dense(cfg.hidden_size, dtype=self.dtype, name="fc2")(h)
+        return x + h
+
+
+class CLIPTextEncoder(nn.Module):
+    """``__call__(input_ids (B, L) int32) -> last_hidden_state (B, L, D)``."""
+
+    config: CLIPTextConfig
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids: jax.Array) -> jax.Array:
+        cfg = self.config
+        b, n = input_ids.shape
+        tok = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=self.dtype, name="token_embedding")(
+            input_ids
+        )
+        pos = self.param(
+            "position_embedding",
+            nn.initializers.normal(0.02),
+            (cfg.max_position_embeddings, cfg.hidden_size),
+        )
+        x = tok + pos[None, :n].astype(self.dtype)
+        # causal mask (CLIP text transformer is autoregressive-masked)
+        mask = jnp.triu(jnp.full((n, n), -jnp.inf, jnp.float32), k=1)[None, None]
+        for i in range(cfg.num_hidden_layers):
+            x = _CLIPLayer(cfg, self.dtype, name=f"layers_{i}")(x, mask)
+        return nn.LayerNorm(
+            epsilon=cfg.layer_norm_eps, dtype=self.dtype, name="final_layer_norm"
+        )(x)

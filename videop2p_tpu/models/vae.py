@@ -1,0 +1,208 @@
+"""AutoencoderKL (the SD image VAE) in flax, applied per frame.
+
+The reference consumes ``diffusers.AutoencoderKL`` as a frozen dependency
+(/root/reference/run_tuning.py:130, run_videop2p.py:108-110): frames fold into
+the batch for encode (run_tuning.py:282-287, run_videop2p.py:530-537) and
+decode runs in chunks of 4 to bound memory (pipeline_tuneavideo.py:239-246).
+This is a from-scratch flax implementation of the same architecture
+(SD-1.x config: 128/256/512/512 channels, 2 resnets per level, mid attention,
+latent scaling 0.18215 applied by callers), channels-last.
+
+``encode_video``/``decode_video`` own the frame folding and decode chunking.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+__all__ = ["VAEConfig", "AutoencoderKL", "encode_video", "decode_video"]
+
+Dtype = jnp.dtype
+
+
+@dataclasses.dataclass(frozen=True)
+class VAEConfig:
+    in_channels: int = 3
+    out_channels: int = 3
+    latent_channels: int = 4
+    block_out_channels: Tuple[int, ...] = (128, 256, 512, 512)
+    layers_per_block: int = 2
+    norm_num_groups: int = 32
+    scaling_factor: float = 0.18215
+
+    @classmethod
+    def tiny(cls, **overrides) -> "VAEConfig":
+        cfg = dict(block_out_channels=(8, 16), layers_per_block=1, norm_num_groups=4)
+        cfg.update(overrides)
+        return cls(**cfg)
+
+
+class _ResnetBlock(nn.Module):
+    """VAE resnet: GN → SiLU → conv → GN → SiLU → conv (no time emb)."""
+
+    features: int
+    groups: int
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        h = nn.GroupNorm(num_groups=self.groups, epsilon=1e-6, dtype=self.dtype, name="norm1")(x)
+        h = nn.silu(h)
+        h = nn.Conv(self.features, (3, 3), padding=1, dtype=self.dtype, name="conv1")(h)
+        h = nn.GroupNorm(num_groups=self.groups, epsilon=1e-6, dtype=self.dtype, name="norm2")(h)
+        h = nn.silu(h)
+        h = nn.Conv(self.features, (3, 3), padding=1, dtype=self.dtype, name="conv2")(h)
+        if x.shape[-1] != self.features:
+            x = nn.Conv(self.features, (1, 1), dtype=self.dtype, name="conv_shortcut")(x)
+        return x + h
+
+
+class _AttnBlock(nn.Module):
+    """Single-head spatial self-attention at the VAE mid block."""
+
+    groups: int
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        b, h, w, c = x.shape
+        res = x
+        x = nn.GroupNorm(num_groups=self.groups, epsilon=1e-6, dtype=self.dtype, name="group_norm")(x)
+        x = x.reshape(b, h * w, c)
+        q = nn.Dense(c, dtype=self.dtype, name="to_q")(x)
+        k = nn.Dense(c, dtype=self.dtype, name="to_k")(x)
+        v = nn.Dense(c, dtype=self.dtype, name="to_v")(x)
+        sim = jnp.einsum("bqc,bkc->bqk", q, k) * (c ** -0.5)
+        probs = jax.nn.softmax(sim.astype(jnp.float32), axis=-1).astype(self.dtype)
+        out = jnp.einsum("bqk,bkc->bqc", probs, v)
+        out = nn.Dense(c, dtype=self.dtype, name="to_out")(out)
+        return res + out.reshape(b, h, w, c)
+
+
+class Encoder(nn.Module):
+    config: VAEConfig
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.config
+        g = cfg.norm_num_groups
+        x = nn.Conv(cfg.block_out_channels[0], (3, 3), padding=1, dtype=self.dtype, name="conv_in")(x)
+        for i, ch in enumerate(cfg.block_out_channels):
+            for j in range(cfg.layers_per_block):
+                x = _ResnetBlock(ch, g, self.dtype, name=f"down_{i}_resnets_{j}")(x)
+            if i < len(cfg.block_out_channels) - 1:
+                # diffusers pads asymmetrically ((0,1),(0,1)) before the
+                # stride-2 conv (Downsample2D pad=0 path)
+                x = jnp.pad(x, ((0, 0), (0, 1), (0, 1), (0, 0)))
+                x = nn.Conv(
+                    ch, (3, 3), strides=(2, 2), padding="VALID", dtype=self.dtype,
+                    name=f"down_{i}_downsample",
+                )(x)
+        ch = cfg.block_out_channels[-1]
+        x = _ResnetBlock(ch, g, self.dtype, name="mid_resnets_0")(x)
+        x = _AttnBlock(g, self.dtype, name="mid_attn")(x)
+        x = _ResnetBlock(ch, g, self.dtype, name="mid_resnets_1")(x)
+        x = nn.GroupNorm(num_groups=g, epsilon=1e-6, dtype=self.dtype, name="conv_norm_out")(x)
+        x = nn.silu(x)
+        return nn.Conv(
+            2 * cfg.latent_channels, (3, 3), padding=1, dtype=self.dtype, name="conv_out"
+        )(x)
+
+
+class Decoder(nn.Module):
+    config: VAEConfig
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, z: jax.Array) -> jax.Array:
+        cfg = self.config
+        g = cfg.norm_num_groups
+        rev = tuple(reversed(cfg.block_out_channels))
+        x = nn.Conv(rev[0], (3, 3), padding=1, dtype=self.dtype, name="conv_in")(z)
+        x = _ResnetBlock(rev[0], g, self.dtype, name="mid_resnets_0")(x)
+        x = _AttnBlock(g, self.dtype, name="mid_attn")(x)
+        x = _ResnetBlock(rev[0], g, self.dtype, name="mid_resnets_1")(x)
+        for i, ch in enumerate(rev):
+            for j in range(cfg.layers_per_block + 1):
+                x = _ResnetBlock(ch, g, self.dtype, name=f"up_{i}_resnets_{j}")(x)
+            if i < len(rev) - 1:
+                b, hh, ww, c = x.shape
+                x = jax.image.resize(x, (b, hh * 2, ww * 2, c), method="nearest")
+                x = nn.Conv(ch, (3, 3), padding=1, dtype=self.dtype, name=f"up_{i}_upsample")(x)
+        x = nn.GroupNorm(num_groups=g, epsilon=1e-6, dtype=self.dtype, name="conv_norm_out")(x)
+        x = nn.silu(x)
+        return nn.Conv(cfg.out_channels, (3, 3), padding=1, dtype=self.dtype, name="conv_out")(x)
+
+
+class AutoencoderKL(nn.Module):
+    """encode → (mean, logvar); decode(z) → image. Latent scaling is the
+    caller's job (×scaling_factor after sampling, ÷ before decode — the
+    reference's 0.18215 at run_videop2p.py:536 / :507)."""
+
+    config: VAEConfig
+    dtype: Dtype = jnp.float32
+
+    def setup(self):
+        self.encoder = Encoder(self.config, self.dtype)
+        self.decoder = Decoder(self.config, self.dtype)
+        self.quant_conv = nn.Conv(2 * self.config.latent_channels, (1, 1), dtype=self.dtype)
+        self.post_quant_conv = nn.Conv(self.config.latent_channels, (1, 1), dtype=self.dtype)
+
+    def encode(self, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        moments = self.quant_conv(self.encoder(x))
+        mean, logvar = jnp.split(moments, 2, axis=-1)
+        return mean, jnp.clip(logvar, -30.0, 20.0)
+
+    def decode(self, z: jax.Array) -> jax.Array:
+        return self.decoder(self.post_quant_conv(z))
+
+    def __call__(self, x: jax.Array, key: jax.Array) -> jax.Array:
+        mean, logvar = self.encode(x)
+        z = mean + jnp.exp(0.5 * logvar) * jax.random.normal(key, mean.shape, mean.dtype)
+        return self.decode(z)
+
+
+def encode_video(
+    vae: AutoencoderKL,
+    params,
+    video: jax.Array,
+    key: jax.Array,
+    *,
+    sample: bool = True,
+) -> jax.Array:
+    """(B, F, H, W, 3) in [-1, 1] → scaled latents (B, F, H/8, W/8, 4).
+
+    Frames fold into batch (run_tuning.py:282-287); posterior is sampled
+    during training (latent_dist.sample, run_tuning.py:285) and taken at the
+    mean for inversion fidelity when ``sample=False``.
+    """
+    b, f = video.shape[:2]
+    flat = video.reshape((b * f,) + video.shape[2:])
+    mean, logvar = vae.apply(params, flat, method=vae.encode)
+    if sample:
+        z = mean + jnp.exp(0.5 * logvar) * jax.random.normal(key, mean.shape, mean.dtype)
+    else:
+        z = mean
+    z = z * vae.config.scaling_factor
+    return z.reshape((b, f) + z.shape[1:])
+
+
+def decode_video(
+    vae: AutoencoderKL, params, latents: jax.Array, *, chunk: int = 4
+) -> jax.Array:
+    """Scaled latents (B, F, h, w, 4) → video (B, F, 8h, 8w, 3) in [-1, 1],
+    decoded ``chunk`` frames at a time (pipeline_tuneavideo.py:243-246)."""
+    b, f = latents.shape[:2]
+    z = latents.reshape((b * f,) + latents.shape[2:]) / vae.config.scaling_factor
+    n = z.shape[0]
+    outs = []
+    for i in range(0, n, chunk):
+        outs.append(vae.apply(params, z[i : i + chunk], method=vae.decode))
+    img = jnp.concatenate(outs, axis=0)
+    return img.reshape((b, f) + img.shape[1:])

@@ -59,6 +59,8 @@ class TuneConfig:
     adam_epsilon: float = 1e-8
     max_grad_norm: float = 1.0
     gradient_accumulation_steps: int = 1
+    # consumed by TrainState.create(params, tx, cfg.trainable_modules) —
+    # callers must pass it through; make_optimizer itself is partition-blind
     trainable_modules: Tuple[str, ...] = DEFAULT_TRAINABLE
     train_batch_size: int = 1
     num_processes: int = 1  # for scale_lr parity (run_tuning.py:152-155)
