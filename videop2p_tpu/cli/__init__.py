@@ -1,0 +1,1 @@
+"""Command-line entry points (Stage-1 tuning, Stage-2 editing, sweeps)."""

@@ -1,0 +1,179 @@
+"""Shared CLI plumbing: config loading, flag surface, model assembly.
+
+Mirrors the reference's OmegaConf-YAML + argparse surface
+(/root/reference/run_tuning.py:398-425, run_videop2p.py:703-733) — the
+reference's config files run unmodified — including the fork's output-dir
+suffix mangling that carries the dependent-noise hyperparameters between
+stages (run_tuning.py:97-99, run_videop2p.py:74-78).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import warnings
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "load_config",
+    "add_dependent_args",
+    "dependent_suffix",
+    "build_models",
+    "encode_prompts",
+    "ModelBundle",
+]
+
+
+def load_config(path: str) -> Dict[str, Any]:
+    import yaml
+
+    with open(path) as f:
+        return yaml.safe_load(f)
+
+
+def add_dependent_args(parser: argparse.ArgumentParser) -> None:
+    """The fork's flag surface (run_tuning.py:401-412, run_videop2p.py:708-720)."""
+    parser.add_argument("--dependent", default=False, action="store_true")
+    parser.add_argument("--ar_sample", default=False, action="store_true")
+    parser.add_argument("--decay_rate", default=0.1, type=float)
+    parser.add_argument("--window_size", default=60, type=int)
+    parser.add_argument("--ar_coeff", default=0.1, type=float)
+    parser.add_argument("--loss_sig", default=False, action="store_true")
+    parser.add_argument("--num_frames", default=60, type=int)
+    parser.add_argument("--eta", default=0.0, type=float)
+    parser.add_argument("--dependent_weights", default=0.0, type=float)
+
+
+def dependent_suffix(
+    *,
+    dependent: bool,
+    decay_rate: float,
+    window_size: int,
+    ar_sample: bool,
+    ar_coeff: float,
+    eta: float,
+    dependent_weights: float,
+) -> str:
+    """The exact Stage-1↔Stage-2 path contract (run_tuning.py:97-99)."""
+    return "_dependent{d}_dr{dr}_ws{ws}_ar{ar}_ac{ac}_eta{e}_dw{dw}".format(
+        d=dependent, dr=decay_rate, ws=window_size, ar=ar_sample, ac=ar_coeff,
+        e=eta, dw=dependent_weights,
+    )
+
+
+@dataclass
+class ModelBundle:
+    unet: Any
+    unet_params: Dict
+    vae: Any
+    vae_params: Optional[Dict]
+    text_encoder: Any
+    text_params: Optional[Dict]
+    tokenizer: Any
+    random_init: bool
+    source_dir: Optional[str]
+
+
+def build_models(
+    pretrained_model_path: Optional[str],
+    *,
+    dtype: jnp.dtype = jnp.bfloat16,
+    frame_attention: str = "auto",
+    gradient_checkpointing: bool = False,
+    tiny: bool = False,
+    seed: int = 0,
+) -> ModelBundle:
+    """Load a diffusers-layout checkpoint dir, or build random-init models.
+
+    Random init (no checkpoint on disk) keeps every code path drivable in
+    weightless environments — outputs are noise, wall-clock is real.
+    """
+    from videop2p_tpu.models import (
+        AutoencoderKL,
+        CLIPTextConfig,
+        CLIPTextEncoder,
+        UNet3DConditionModel,
+        UNet3DConfig,
+        VAEConfig,
+    )
+    from videop2p_tpu.utils.tokenizers import load_tokenizer
+
+    key = jax.random.key(seed)
+    has_ckpt = pretrained_model_path is not None and os.path.isdir(
+        os.path.join(pretrained_model_path, "unet")
+    )
+    if has_ckpt:
+        from videop2p_tpu.models.pipeline_io import load_pipeline
+
+        loaded = load_pipeline(
+            pretrained_model_path,
+            dtype=dtype,
+            frame_attention=frame_attention,
+            gradient_checkpointing=gradient_checkpointing,
+        )
+        if loaded.inflation_report["kept_init"]:
+            print(
+                f"[build_models] inflated 2D checkpoint: "
+                f"{len(loaded.inflation_report['kept_init'])} temporal params keep init"
+            )
+        tokenizer = load_tokenizer(pretrained_model_path)
+        return ModelBundle(
+            unet=loaded.unet,
+            unet_params=loaded.unet_params,
+            vae=loaded.vae,
+            vae_params=loaded.vae_params,
+            text_encoder=loaded.text_encoder,
+            text_params=loaded.text_params,
+            tokenizer=tokenizer,
+            random_init=False,
+            source_dir=pretrained_model_path,
+        )
+
+    warnings.warn(
+        f"no checkpoint at {pretrained_model_path!r} — building RANDOM-INIT "
+        "models (smoke/benchmark mode; outputs will be noise)",
+        stacklevel=2,
+    )
+    ucfg = UNet3DConfig.tiny() if tiny else UNet3DConfig.sd15()
+    ucfg = type(ucfg)(**{
+        **ucfg.__dict__,
+        "frame_attention": frame_attention,
+        "gradient_checkpointing": gradient_checkpointing,
+    })
+    vcfg = VAEConfig.tiny() if tiny else VAEConfig()
+    ccfg = CLIPTextConfig.tiny() if tiny else CLIPTextConfig()
+    if tiny:
+        ucfg = type(ucfg)(**{**ucfg.__dict__, "cross_attention_dim": ccfg.hidden_size})
+    unet = UNet3DConditionModel(config=ucfg, dtype=dtype)
+    vae = AutoencoderKL(config=vcfg, dtype=dtype)
+    text_encoder = CLIPTextEncoder(config=ccfg, dtype=dtype)
+    s = ucfg.sample_size
+    probe = jnp.zeros((1, 2, s, s, ucfg.in_channels), dtype)
+    tprobe = jnp.zeros((1, 77, ucfg.cross_attention_dim), dtype)
+    px = 8 * s if not tiny else 8 * s
+    unet_params = jax.jit(unet.init)(key, probe, jnp.asarray(0), tprobe)
+    vae_params = jax.jit(vae.init)(key, jnp.zeros((1, 64, 64, vcfg.in_channels), dtype), key)
+    text_params = jax.jit(text_encoder.init)(key, jnp.zeros((1, 8), jnp.int32))
+    return ModelBundle(
+        unet=unet,
+        unet_params=dict(unet_params),
+        vae=vae,
+        vae_params=dict(vae_params),
+        text_encoder=text_encoder,
+        text_params=dict(text_params),
+        tokenizer=load_tokenizer(None),
+        random_init=True,
+        source_dir=None,
+    )
+
+
+def encode_prompts(bundle: ModelBundle, prompts) -> jax.Array:
+    """(P, 77, D) text embeddings via the bundled CLIP encoder."""
+    ids = jnp.asarray(
+        [bundle.tokenizer.encode_padded(p) for p in prompts], jnp.int32
+    )
+    return jax.jit(bundle.text_encoder.apply)(bundle.text_params, ids)

@@ -1,0 +1,274 @@
+"""Stage-1 one-shot tuning entry point.
+
+TPU-native re-design of /root/reference/run_tuning.py: same YAML schema
+(configs/rabbit-jump-tune.yaml) and flag surface, driving the pure
+``train_step`` in a host loop with checkpointing, resume, and the
+inversion+sampling validation the reference runs every ``validation_steps``
+(run_tuning.py:346-375). Ends by writing the diffusers-layout pipeline dir
+Stage 2 consumes (run_tuning.py:387-393).
+
+Run:  python -m videop2p_tpu.cli.run_tuning --config configs/rabbit-jump-tune.yaml
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from videop2p_tpu.cli.common import (
+    add_dependent_args,
+    build_models,
+    dependent_suffix,
+    encode_prompts,
+    load_config,
+)
+from videop2p_tpu.core import DDIMScheduler, DDPMScheduler, DependentNoiseSampler
+from videop2p_tpu.data import SingleVideoDataset
+from videop2p_tpu.models import decode_video, encode_video
+from videop2p_tpu.models.pipeline_io import save_pipeline
+from videop2p_tpu.pipelines import ddim_inversion, edit_sample, make_unet_fn
+from videop2p_tpu.train import (
+    TrainState,
+    TuneConfig,
+    latest_checkpoint,
+    make_optimizer,
+    restore_checkpoint,
+    save_checkpoint,
+    train_step,
+)
+from videop2p_tpu.utils.profiling import phase_timer
+from videop2p_tpu.utils.video_io import save_videos_grid
+
+
+def main(
+    pretrained_model_path: str,
+    output_dir: str,
+    train_data: Dict[str, Any],
+    validation_data: Dict[str, Any],
+    learning_rate: float = 3e-5,
+    train_batch_size: int = 1,
+    max_train_steps: int = 500,
+    checkpointing_steps: int = 1000,
+    validation_steps: int = 500,
+    trainable_modules=("attn1.to_q", "attn2.to_q", "attn_temp"),
+    seed: Optional[int] = None,
+    mixed_precision: str = "fp16",
+    gradient_checkpointing: bool = True,
+    gradient_accumulation_steps: int = 1,
+    max_grad_norm: float = 1.0,
+    lr_scheduler: str = "constant",
+    lr_warmup_steps: int = 0,
+    scale_lr: bool = False,
+    resume_from_checkpoint: Optional[str] = None,
+    prediction_type: str = "epsilon",
+    # fork flags (run_tuning.py:401-412)
+    dependent: bool = False,
+    num_frames: int = 60,
+    decay_rate: float = 0.1,
+    window_size: int = 60,
+    ar_sample: bool = False,
+    ar_coeff: float = 0.1,
+    eta: float = 0.0,
+    dependent_weights: float = 0.0,
+    # extras (not in the reference)
+    tiny: bool = False,
+    log_every: int = 50,
+    **unused,
+) -> str:
+    del unused
+    n_frames = int(train_data.get("n_sample_frames", 8))
+    output_dir = output_dir + dependent_suffix(
+        dependent=dependent, decay_rate=decay_rate, window_size=window_size,
+        ar_sample=ar_sample, ar_coeff=ar_coeff, eta=eta,
+        dependent_weights=dependent_weights,
+    )
+    os.makedirs(output_dir, exist_ok=True)
+    with open(os.path.join(output_dir, "config.json"), "w") as f:
+        json.dump({k: v for k, v in locals().items()
+                   if isinstance(v, (str, int, float, bool, dict, list, tuple, type(None)))},
+                  f, indent=2, default=str)
+
+    sampler = None
+    if dependent:
+        if num_frames != n_frames:
+            print(f"[tune] dependent sampler uses the clip's {n_frames} frames "
+                  f"(--num_frames {num_frames} would not match the data)")
+        sampler = DependentNoiseSampler.create(
+            num_frames=n_frames, decay_rate=decay_rate,
+            window_size=min(window_size, n_frames), ar_sample=ar_sample,
+            ar_coeff=ar_coeff,
+        )
+
+    dtype = {"fp16": jnp.bfloat16, "bf16": jnp.bfloat16, "no": jnp.float32}[mixed_precision]
+    bundle = build_models(
+        pretrained_model_path, dtype=dtype, frame_attention="chunked",
+        gradient_checkpointing=gradient_checkpointing, tiny=tiny,
+        seed=seed or 0,
+    )
+
+    # data → latents (VAE encode once; the clip is fixed, run_tuning.py:282-287)
+    ds = SingleVideoDataset(
+        video_path=train_data["video_path"],
+        prompt=train_data["prompt"],
+        width=int(train_data.get("width", 512)),
+        height=int(train_data.get("height", 512)),
+        n_sample_frames=n_frames,
+        sample_start_idx=int(train_data.get("sample_start_idx", 0)),
+        sample_frame_rate=int(train_data.get("sample_frame_rate", 1)),
+    )
+    video = jnp.asarray(ds.load())[None]  # (1, F, H, W, 3)
+    key = jax.random.key(seed if seed is not None else 0)
+    key, ek = jax.random.split(key)
+    with phase_timer("vae_encode"):
+        latents = encode_video(bundle.vae, bundle.vae_params, video.astype(dtype), ek)
+        latents = jax.block_until_ready(latents.astype(jnp.float32))
+    text_emb = encode_prompts(bundle, [train_data["prompt"]])
+
+    tune_cfg = TuneConfig(
+        learning_rate=learning_rate,
+        scale_lr=scale_lr,
+        lr_scheduler=lr_scheduler,
+        lr_warmup_steps=lr_warmup_steps,
+        max_train_steps=max_train_steps,
+        max_grad_norm=max_grad_norm,
+        gradient_accumulation_steps=gradient_accumulation_steps,
+        trainable_modules=tuple(trainable_modules),
+        train_batch_size=train_batch_size,
+    )
+    tx = make_optimizer(tune_cfg)
+    params = bundle.unet_params["params"]
+    state = TrainState.create(params, tx, tune_cfg.trainable_modules)
+
+    first_step = 0
+    if resume_from_checkpoint:
+        path = (
+            latest_checkpoint(output_dir)
+            if resume_from_checkpoint == "latest"
+            else resume_from_checkpoint
+        )
+        if path:
+            state = restore_checkpoint(path, state)
+            first_step = int(state.step)
+            print(f"[tune] resumed from {path} at step {first_step}")
+
+    noise_sched = DDPMScheduler.create_sd(prediction_type=prediction_type)
+    unet_fn = make_unet_fn(bundle.unet)
+    step_fn = jax.jit(
+        lambda s, k: train_step(
+            unet_fn, tx, s, noise_sched, latents, text_emb, k,
+            dependent_sampler=sampler,
+        )
+    )
+
+    t0 = time.time()
+    for i in range(first_step, max_train_steps):
+        key, sk = jax.random.split(key)
+        state, loss = step_fn(state, sk)
+        if (i + 1) % log_every == 0 or i == first_step:
+            loss = float(jax.block_until_ready(loss))
+            rate = (i + 1 - first_step) / max(time.time() - t0, 1e-9)
+            print(f"[tune] step {i + 1}/{max_train_steps} loss={loss:.4f} "
+                  f"({rate:.2f} it/s)")
+        if (i + 1) % checkpointing_steps == 0:
+            save_checkpoint(output_dir, jax.device_get(state), i + 1)
+        if (i + 1) % validation_steps == 0 or (i + 1) == max_train_steps:
+            _validate(
+                bundle, state, latents, validation_data, output_dir, i + 1,
+                dependent_weights=dependent_weights, sampler=sampler,
+                text_emb=text_emb, key=key,
+            )
+
+    save_pipeline(
+        output_dir,
+        bundle.unet.config,
+        {"params": state.params},
+        source_dir=bundle.source_dir,
+        scheduler_config={
+            "_class_name": "DDIMScheduler",
+            "beta_start": 0.00085,
+            "beta_end": 0.012,
+            "beta_schedule": "scaled_linear",
+            "clip_sample": False,
+            "set_alpha_to_one": False,
+            "steps_offset": 1,
+        },
+    )
+    print(f"[tune] saved pipeline to {output_dir}")
+    return output_dir
+
+
+def _validate(
+    bundle, state, latents, validation_data, output_dir, step, *,
+    dependent_weights, sampler, text_emb, key,
+):
+    """Inversion + sampling validation (run_tuning.py:346-375): DDIM-invert
+    the training latents, store them, sample each validation prompt from the
+    inverted noise, write a GIF grid."""
+    num_inv = int(validation_data.get("num_inv_steps", 50))
+    num_steps = int(validation_data.get("num_inference_steps", 50))
+    guidance = float(validation_data.get("guidance_scale", 12.5))
+    use_inv = bool(validation_data.get("use_inv_latent", True))
+    prompts: List[str] = list(validation_data.get("prompts", []))
+    unet_fn = make_unet_fn(bundle.unet)
+    sched = DDIMScheduler.create_sd()
+    params = {"params": state.params}
+
+    with phase_timer("validation"):
+        if use_inv:
+            traj = ddim_inversion(
+                unet_fn, params, sched, latents, text_emb,
+                num_inference_steps=num_inv,
+                dependent_weight=dependent_weights,
+                dependent_sampler=sampler if dependent_weights > 0 else None,
+                key=key,
+            )
+            x_t = traj[-1]
+            inv_dir = os.path.join(output_dir, "inv_latents")
+            os.makedirs(inv_dir, exist_ok=True)
+            np.save(os.path.join(inv_dir, f"ddim_latent-{step}.npy"),
+                    np.asarray(jax.device_get(x_t)))
+        else:
+            x_t = jax.random.normal(key, latents.shape, latents.dtype)
+
+        videos = []
+        for prompt in prompts:
+            cond = encode_prompts(bundle, [prompt])
+            uncond = encode_prompts(bundle, [""])[0]
+            out = edit_sample(
+                unet_fn, params, sched, x_t, cond, uncond,
+                num_inference_steps=num_steps, guidance_scale=guidance,
+            )
+            frames = decode_video(bundle.vae, bundle.vae_params, out.astype(jnp.float32))
+            videos.append(np.asarray(jax.device_get((frames + 1) / 2))[0])
+    if videos:
+        path = os.path.join(output_dir, "samples", f"sample-{step}.gif")
+        save_videos_grid(np.stack(videos), path)
+        print(f"[tune] validation saved {path}")
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--config", type=str, required=True)
+    parser.add_argument("--tiny", action="store_true",
+                        help="random-init tiny models (weightless smoke mode)")
+    add_dependent_args(parser)
+    args = parser.parse_args()
+    main(
+        **load_config(args.config),
+        dependent=args.dependent,
+        num_frames=args.num_frames,
+        decay_rate=args.decay_rate,
+        window_size=args.window_size,
+        ar_sample=args.ar_sample,
+        ar_coeff=args.ar_coeff,
+        eta=args.eta,
+        dependent_weights=args.dependent_weights,
+        tiny=args.tiny,
+    )

@@ -1,0 +1,5 @@
+"""Datasets and frame loading."""
+
+from videop2p_tpu.data.dataset import SingleVideoDataset, load_frame_sequence
+
+__all__ = ["SingleVideoDataset", "load_frame_sequence"]

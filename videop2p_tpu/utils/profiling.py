@@ -1,0 +1,53 @@
+"""Tracing/profiling hooks (SURVEY §5.1 — the reference has none; the
+north-star metric is wall-clock, so per-phase timing is first-class here).
+
+``phase_timer`` prints wall-clock per named phase and keeps a process-local
+record for reporting; ``trace`` wraps ``jax.profiler`` for TensorBoard-viewable
+device traces when a trace dir is set (VIDEOP2P_TRACE_DIR env var).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import Dict, Iterator, List, Tuple
+
+__all__ = ["phase_timer", "phase_records", "trace"]
+
+_RECORDS: List[Tuple[str, float]] = []
+
+
+def phase_records() -> Dict[str, float]:
+    """Total seconds per phase name, accumulated across the process."""
+    out: Dict[str, float] = {}
+    for name, dt in _RECORDS:
+        out[name] = out.get(name, 0.0) + dt
+    return out
+
+
+@contextlib.contextmanager
+def phase_timer(name: str, *, verbose: bool = True) -> Iterator[None]:
+    t0 = time.time()
+    try:
+        yield
+    finally:
+        dt = time.time() - t0
+        _RECORDS.append((name, dt))
+        if verbose:
+            print(f"[phase] {name}: {dt:.2f}s")
+
+
+@contextlib.contextmanager
+def trace(name: str) -> Iterator[None]:
+    """jax.profiler trace when VIDEOP2P_TRACE_DIR is set, else a no-op."""
+    trace_dir = os.environ.get("VIDEOP2P_TRACE_DIR")
+    if not trace_dir:
+        with phase_timer(name):
+            yield
+        return
+    import jax
+
+    with jax.profiler.trace(os.path.join(trace_dir, name)):
+        with phase_timer(name):
+            yield

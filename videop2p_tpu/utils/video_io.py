@@ -1,0 +1,65 @@
+"""Video artifact output: GIF grids (and mp4 when available).
+
+Re-design of ``save_videos_grid`` (/root/reference/tuneavideo/util.py:16-28):
+a batch of videos is tiled into one animated grid and written as a GIF at
+fps 8. The reference goes through torchvision's make_grid; here the grid is a
+couple of numpy reshapes (inputs are channels-last already).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["save_videos_grid", "to_uint8"]
+
+
+def to_uint8(videos: np.ndarray) -> np.ndarray:
+    """float [0, 1] (or uint8 passthrough) → uint8."""
+    videos = np.asarray(videos)
+    if videos.dtype == np.uint8:
+        return videos
+    return (np.clip(np.asarray(videos, dtype=np.float32), 0.0, 1.0) * 255).astype(np.uint8)
+
+
+def make_grid(frames: np.ndarray, n_rows: int, pad: int = 2) -> np.ndarray:
+    """(B, H, W, C) uint8 → one tiled (gH, gW, C) frame."""
+    b, h, w, c = frames.shape
+    cols = n_rows  # torchvision nrow = images per row
+    rows = math.ceil(b / cols)
+    grid = np.zeros((rows * (h + pad) + pad, cols * (w + pad) + pad, c), np.uint8)
+    for i in range(b):
+        r, col = divmod(i, cols)
+        y, x = pad + r * (h + pad), pad + col * (w + pad)
+        grid[y : y + h, x : x + w] = frames[i]
+    return grid
+
+
+def save_videos_grid(
+    videos: np.ndarray,
+    path: str,
+    *,
+    n_rows: Optional[int] = None,
+    fps: int = 8,
+) -> str:
+    """Write (B, F, H, W, C) videos in [0, 1] as one animated GIF grid
+    (util.py:16-28; fps=8 matches the reference's duration). ``.mp4`` paths
+    write mp4 when imageio-ffmpeg is available, else fall back to ``.gif``."""
+    import imageio
+
+    videos = to_uint8(videos)
+    b, f = videos.shape[:2]
+    n_rows = n_rows if n_rows is not None else b
+    frames = [make_grid(videos[:, t], n_rows) for t in range(f)]
+    os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
+    if path.endswith(".mp4"):
+        try:
+            imageio.mimsave(path, frames, fps=fps)
+            return path
+        except Exception:
+            path = path[:-4] + ".gif"
+    imageio.mimsave(path, frames, duration=1000.0 / fps, loop=0)
+    return path
