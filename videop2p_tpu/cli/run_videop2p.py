@@ -1,0 +1,240 @@
+"""Stage-2 attention-controlled editing entry point.
+
+TPU-native re-design of /root/reference/run_videop2p.py: same YAML schema
+(configs/rabbit-jump-p2p.yaml) and flag surface. Flow (run_videop2p.py:42-701):
+load the Stage-1 pipeline dir (with the fork's dependent-suffix path
+contract), load + VAE-encode the frame sequence, DDIM-invert it, optionally
+run null-text optimization (full mode), build the controller from the edit
+spec, run the controlled CFG denoise, and write two GIFs — the inversion
+reconstruction stream and the edited stream (run_videop2p.py:692-701).
+
+Run:  python -m videop2p_tpu.cli.run_videop2p --config configs/rabbit-jump-p2p.yaml --fast
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import time
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from videop2p_tpu.cli.common import (
+    add_dependent_args,
+    build_models,
+    dependent_suffix,
+    encode_prompts,
+    load_config,
+)
+from videop2p_tpu.control import make_controller
+from videop2p_tpu.core import DDIMScheduler, DependentNoiseSampler
+from videop2p_tpu.data import load_frame_sequence
+from videop2p_tpu.models import decode_video, encode_video
+from videop2p_tpu.pipelines import (
+    ddim_inversion,
+    edit_sample,
+    make_unet_fn,
+    null_text_optimization,
+)
+from videop2p_tpu.utils.profiling import phase_timer
+from videop2p_tpu.utils.video_io import save_video_gif
+
+# module-level working-point constants (run_videop2p.py:32-40)
+NUM_DDIM_STEPS = 50
+GUIDANCE_SCALE = 7.5
+MASK_TH = (0.3, 0.3)
+
+
+def main(
+    pretrained_model_path: str,
+    image_path: str,
+    prompt: str,
+    prompts: Sequence[str],
+    save_name: str,
+    is_word_swap: bool,
+    eq_params: Optional[Dict] = None,
+    blend_word: Optional[Sequence[str]] = None,
+    cross_replace_steps: float = 0.2,
+    self_replace_steps: float = 0.5,
+    video_len: int = 8,
+    fast: bool = False,
+    mixed_precision: str = "fp32",
+    # fork flags (run_videop2p.py:708-720)
+    dependent: bool = False,
+    dependent_p2p: bool = False,
+    num_frames: int = 60,
+    decay_rate: float = 0.1,
+    window_size: int = 60,
+    ar_sample: bool = False,
+    ar_coeff: float = 0.1,
+    eta: float = 0.0,
+    dependent_weights: float = 0.0,
+    # extras (not in the reference)
+    tiny: bool = False,
+    width: int = 512,
+    num_inner_steps: int = 10,
+    seed: int = 0,
+    **unused,
+) -> Tuple[str, str]:
+    """Returns the (inversion_gif, edit_gif) paths it wrote."""
+    del unused
+    if tiny and width == 512:
+        # the tiny VAE downsamples 2×, not 8× — keep latents at the tiny
+        # UNet's 8×8 working point so smoke runs stay small
+        width = 16
+    # Stage-1 ↔ Stage-2 path contract: the tuning run mangled its output dir
+    # with the dependent hyperparameters (run_videop2p.py:74-78); results land
+    # inside the checkpoint dir under results_dp{dependent_p2p} (:79)
+    pretrained_model_path = pretrained_model_path + dependent_suffix(
+        dependent=dependent, decay_rate=decay_rate, window_size=window_size,
+        ar_sample=ar_sample, ar_coeff=ar_coeff, eta=eta,
+        dependent_weights=dependent_weights,
+    )
+    output_folder = os.path.join(pretrained_model_path, f"results_dp{dependent_p2p}")
+    suffix = "_fast" if fast else ""
+    inversion_gif = os.path.join(output_folder, f"inversion{suffix}.gif")
+    edit_gif = os.path.join(output_folder, f"{save_name}{suffix}.gif")
+    os.makedirs(output_folder, exist_ok=True)
+
+    sampler = None
+    if dependent_p2p or (dependent and eta > 0):
+        sampler = DependentNoiseSampler.create(
+            num_frames=video_len, decay_rate=decay_rate,
+            window_size=min(window_size, video_len), ar_sample=ar_sample,
+            ar_coeff=ar_coeff,
+        )
+
+    # the reference keeps the Stage-2 UNet fp32 regardless of mixed_precision
+    # (run_videop2p.py:111-113) — scheduler/inversion math here is fp32 too;
+    # mixed_precision only sets the VAE/CLIP compute dtype
+    dtype = {"fp16": jnp.bfloat16, "bf16": jnp.bfloat16, "fp32": jnp.float32,
+             "no": jnp.float32}[mixed_precision]
+    bundle = build_models(
+        pretrained_model_path, dtype=dtype, frame_attention="chunked", tiny=tiny,
+        seed=seed,
+    )
+    unet_fn = make_unet_fn(bundle.unet)
+    params = bundle.unet_params
+    sched = DDIMScheduler.create_sd()
+    key = jax.random.key(seed)
+
+    # ---- load + encode the video ----------------------------------------
+    frames = load_frame_sequence(image_path, size=width, num_frames=video_len)
+    video = jnp.asarray(frames, jnp.float32)[None] / 127.5 - 1.0  # (1,F,H,W,3)
+    with phase_timer("vae_encode"):
+        # posterior mean, not a sample — inversion fidelity
+        # (image2latent_video, run_videop2p.py:530-537)
+        latents = encode_video(
+            bundle.vae, bundle.vae_params, video.astype(dtype), key, sample=False
+        )
+        latents = jax.block_until_ready(latents.astype(jnp.float32))
+
+    cond_src = encode_prompts(bundle, [prompt])
+    cond_all = encode_prompts(bundle, list(prompts))
+    uncond = encode_prompts(bundle, [""])[0]
+
+    # ---- DDIM inversion (+ null-text in full mode) ----------------------
+    dep_w = dependent_weights if dependent_p2p else 0.0
+    key, ik = jax.random.split(key)
+    with phase_timer("ddim_inversion"):
+        traj = jax.jit(
+            lambda p, x, k: ddim_inversion(
+                unet_fn, p, sched, x, cond_src,
+                num_inference_steps=NUM_DDIM_STEPS,
+                dependent_weight=dep_w,
+                dependent_sampler=sampler if dep_w > 0 else None,
+                key=k,
+            )
+        )(params, latents, ik)
+        x_t = jax.block_until_ready(traj[-1])
+
+    null_embeddings = None
+    if not fast:
+        key, nk = jax.random.split(key)
+        with phase_timer("null_text_optimization"):
+            null_embeddings = null_text_optimization(
+                unet_fn, params, sched, traj, cond_src, uncond[None],
+                num_inference_steps=NUM_DDIM_STEPS,
+                guidance_scale=GUIDANCE_SCALE,
+                num_inner_steps=num_inner_steps,
+                dependent_weight=dep_w,
+                dependent_sampler=sampler if dep_w > 0 else None,
+                key=nk,
+            )
+            null_embeddings = jax.block_until_ready(null_embeddings)
+
+    # ---- controller + controlled denoise --------------------------------
+    print("Start Video-P2P!")
+    blend_words = None
+    if blend_word:
+        # the config's 2-list becomes ((src_words,), (edit_words,))
+        # (run_videop2p.py:87-88)
+        blend_words = ((blend_word[0],), (blend_word[1],))
+    ctx = make_controller(
+        list(prompts),
+        bundle.tokenizer,
+        num_steps=NUM_DDIM_STEPS,
+        is_replace_controller=bool(is_word_swap),
+        cross_replace_steps=cross_replace_steps,
+        self_replace_steps=self_replace_steps,
+        blend_words=blend_words,
+        equalizer_params=dict(eq_params) if eq_params else None,
+        mask_th=MASK_TH,
+    )
+    key, ek = jax.random.split(key)
+    t0 = time.time()
+    with phase_timer("edit_sample"):
+        out = jax.jit(
+            lambda p, x, u, k: edit_sample(
+                unet_fn, p, sched, x, cond_all, u,
+                num_inference_steps=NUM_DDIM_STEPS,
+                guidance_scale=GUIDANCE_SCALE,
+                ctx=ctx,
+                source_uses_cfg=not fast,
+                eta=eta,
+                key=k,
+                dependent_sampler=sampler if (dependent_p2p and eta > 0) else None,
+                null_uncond_embeddings=null_embeddings,
+            )
+        )(params, x_t, uncond, ek)
+        out = jax.block_until_ready(out)
+    print(f"[p2p] controlled denoise done in {time.time() - t0:.1f}s")
+
+    with phase_timer("vae_decode"):
+        videos = decode_video(bundle.vae, bundle.vae_params, out.astype(dtype))
+        videos = np.asarray(jax.device_get((videos.astype(jnp.float32) + 1) / 2))
+
+    # stream 0 = inversion reconstruction, stream 1 = edit
+    # (run_videop2p.py:688-701; duration 250 ms/frame = 4 fps)
+    save_video_gif(videos[0], inversion_gif, fps=4)
+    save_video_gif(videos[1], edit_gif, fps=4)
+    print(f"[p2p] wrote {inversion_gif} and {edit_gif}")
+    return inversion_gif, edit_gif
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--config", type=str, default="./configs/videop2p.yaml")
+    parser.add_argument("--fast", action="store_true")
+    parser.add_argument("--dependent_p2p", default=False, action="store_true")
+    parser.add_argument("--tiny", action="store_true",
+                        help="random-init tiny models (weightless smoke mode)")
+    add_dependent_args(parser)
+    args = parser.parse_args()
+    main(
+        **load_config(args.config),
+        fast=args.fast,
+        dependent=args.dependent,
+        dependent_p2p=args.dependent_p2p,
+        num_frames=args.num_frames,
+        decay_rate=args.decay_rate,
+        window_size=args.window_size,
+        ar_sample=args.ar_sample,
+        ar_coeff=args.ar_coeff,
+        eta=args.eta,
+        dependent_weights=args.dependent_weights,
+        tiny=args.tiny,
+    )

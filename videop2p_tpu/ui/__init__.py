@@ -1,0 +1,8 @@
+"""Demo / distribution layer: config-building trainer, inference wrapper,
+Hub upload, and the Gradio app (gradio and huggingface_hub are optional —
+every import of them is gated)."""
+
+from videop2p_tpu.ui.trainer import Trainer, find_exp_dirs, save_model_card
+from videop2p_tpu.ui.inference import InferencePipeline
+
+__all__ = ["Trainer", "InferencePipeline", "find_exp_dirs", "save_model_card"]

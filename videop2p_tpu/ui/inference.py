@@ -1,0 +1,90 @@
+"""In-process inference wrapper for the demo UI.
+
+Re-design of /root/reference/gradio_utils/inference.py: loads a tuned
+experiment checkpoint once, then samples videos for arbitrary prompts
+(optionally from the stored DDIM-inverted latent, inference.py:73-96) and
+writes the result as a GIF for the UI to display.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["InferencePipeline"]
+
+
+class InferencePipeline:
+    def __init__(self, checkpoint_dir: Optional[str] = None):
+        self.checkpoint_dir: Optional[str] = None
+        self._bundle = None
+        if checkpoint_dir:
+            self.load(checkpoint_dir)
+
+    def load(self, checkpoint_dir: str) -> None:
+        """(Re)load a tuned pipeline dir; no-op if already loaded
+        (inference.py:47-59)."""
+        if checkpoint_dir == self.checkpoint_dir and self._bundle is not None:
+            return
+        from videop2p_tpu.cli.common import build_models
+
+        self._bundle = build_models(checkpoint_dir, dtype=jnp.bfloat16)
+        self.checkpoint_dir = checkpoint_dir
+
+    def _latest_inv_latent(self) -> Optional[np.ndarray]:
+        """The newest Stage-1 validation inversion latent, if any
+        (inference.py:73-79 loads inv_latents/ddim_latent-*.pt)."""
+        assert self.checkpoint_dir is not None
+        paths = glob.glob(os.path.join(self.checkpoint_dir, "inv_latents", "*.npy"))
+        if not paths:
+            return None
+        return np.load(max(paths, key=os.path.getmtime))
+
+    def run(
+        self,
+        prompt: str,
+        *,
+        video_length: int = 8,
+        num_steps: int = 50,
+        guidance_scale: float = 7.5,
+        seed: int = 0,
+        use_inv_latent: bool = True,
+        out_path: str = "out.gif",
+        height: int = 512,
+        width: int = 512,
+    ) -> str:
+        """Sample one video and write it to ``out_path``; returns the path."""
+        if self._bundle is None:
+            raise RuntimeError("load() a checkpoint dir first")
+        from videop2p_tpu.cli.common import encode_prompts
+        from videop2p_tpu.core import DDIMScheduler
+        from videop2p_tpu.models import decode_video
+        from videop2p_tpu.pipelines import edit_sample, make_unet_fn
+        from videop2p_tpu.utils.video_io import save_video_gif
+
+        bundle = self._bundle
+        key = jax.random.key(seed)
+        x_t = None
+        if use_inv_latent:
+            inv = self._latest_inv_latent()
+            if inv is not None:
+                x_t = jnp.asarray(inv)
+        if x_t is None:
+            x_t = jax.random.normal(
+                key, (1, video_length, height // 8, width // 8, 4), jnp.float32
+            )
+        cond = encode_prompts(bundle, [prompt])
+        uncond = encode_prompts(bundle, [""])[0]
+        unet_fn = make_unet_fn(bundle.unet)
+        out = edit_sample(
+            unet_fn, bundle.unet_params, DDIMScheduler.create_sd(), x_t, cond, uncond,
+            num_inference_steps=num_steps, guidance_scale=guidance_scale, key=key,
+        )
+        frames = decode_video(bundle.vae, bundle.vae_params, out.astype(jnp.bfloat16))
+        video = np.asarray(jax.device_get((frames.astype(jnp.float32) + 1) / 2))[0]
+        return save_video_gif(video, out_path, fps=8)

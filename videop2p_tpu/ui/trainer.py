@@ -1,0 +1,240 @@
+"""UI-facing training/editing driver.
+
+Re-design of /root/reference/gradio_utils/trainer.py and utils.py: the UI
+never imports the heavy stacks directly — it writes a merged YAML config into
+an experiment directory and launches the CLI entry points as subprocesses
+(trainer.py:154-155, :285-286), so a crash in a run can't take down the demo
+process and artifacts flow through the experiments/ dir.
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+import pathlib
+import re
+import subprocess
+import sys
+from typing import Dict, List, Optional
+
+import yaml
+
+__all__ = ["Trainer", "find_exp_dirs", "save_model_card"]
+
+
+def _slugify(name: str) -> str:
+    name = re.sub(r"[^a-zA-Z0-9._-]+", "-", name.strip().lower())
+    return re.sub(r"-+", "-", name).strip("-") or "exp"
+
+
+def find_exp_dirs(root: str = "experiments") -> List[str]:
+    """Experiment dirs that contain a finished pipeline (model_index.json),
+    newest first (utils.py:30-47)."""
+    rootp = pathlib.Path(root)
+    if not rootp.is_dir():
+        return []
+    dirs = [p.parent for p in rootp.glob("**/model_index.json")]
+    dirs.sort(key=lambda p: p.stat().st_mtime, reverse=True)
+    return [str(p) for p in dirs]
+
+
+def save_model_card(
+    save_dir: str,
+    *,
+    base_model: str,
+    training_prompt: str,
+    test_prompt: str = "",
+    sample_gif: Optional[str] = None,
+) -> str:
+    """Write a README model card into an experiment dir (utils.py:50-67)."""
+    image_block = f"![sample]({sample_gif})\n" if sample_gif else ""
+    card = f"""---
+license: creativeml-openrail-m
+base_model: {base_model}
+tags:
+- video-p2p
+- text-to-video
+- tpu
+---
+# Video-P2P (TPU) — {os.path.basename(save_dir)}
+
+One-shot video tuning + prompt-to-prompt editing checkpoint.
+
+- base model: `{base_model}`
+- training prompt: `{training_prompt}`
+- test prompt: `{test_prompt}`
+
+{image_block}"""
+    path = os.path.join(save_dir, "README.md")
+    os.makedirs(save_dir, exist_ok=True)
+    with open(path, "w") as f:
+        f.write(card)
+    return path
+
+
+class Trainer:
+    """Builds configs and shells out to the CLI entry points."""
+
+    def __init__(self, experiments_dir: str = "experiments",
+                 checkpoint_dir: str = "checkpoints"):
+        self.experiments_dir = pathlib.Path(experiments_dir)
+        self.checkpoint_dir = pathlib.Path(checkpoint_dir)
+        self.experiments_dir.mkdir(exist_ok=True)
+        self.checkpoint_dir.mkdir(exist_ok=True)
+
+    def resolve_base_model(self, base_model_id: str) -> str:
+        """Local checkpoint path for a model id. Looks under checkpoint_dir
+        first; falls back to a huggingface_hub snapshot when the package and
+        network are available (trainer.py:34-51 clones from the Hub)."""
+        local = self.checkpoint_dir / base_model_id
+        if local.is_dir():
+            return local.as_posix()
+        if os.path.isdir(base_model_id):
+            return base_model_id
+        try:
+            import huggingface_hub
+
+            return huggingface_hub.snapshot_download(base_model_id)
+        except Exception:
+            # weightless fallback: the CLIs random-init when the path has no
+            # checkpoint — demo stays drivable offline
+            return local.as_posix()
+
+    def build_tune_config(
+        self,
+        *,
+        video_path: str,
+        training_prompt: str,
+        validation_prompt: str,
+        base_model: str,
+        output_dir: str,
+        resolution: int = 512,
+        n_sample_frames: int = 8,
+        n_steps: int = 300,
+        learning_rate: float = 3.5e-5,
+        gradient_accumulation: int = 1,
+        seed: int = 0,
+        mixed_precision: str = "bf16",
+        checkpointing_steps: int = 1000,
+        validation_steps: int = 100,
+    ) -> Dict:
+        """The merged Stage-1 config the reference's UI assembles from its
+        template (trainer.py:117-152)."""
+        return {
+            "pretrained_model_path": self.resolve_base_model(base_model),
+            "output_dir": output_dir,
+            "train_data": {
+                "video_path": video_path,
+                "prompt": training_prompt,
+                "n_sample_frames": n_sample_frames,
+                "width": resolution,
+                "height": resolution,
+                "sample_start_idx": 0,
+                "sample_frame_rate": 1,
+            },
+            "validation_data": {
+                "prompts": [validation_prompt],
+                "video_length": n_sample_frames,
+                "width": resolution,
+                "height": resolution,
+                "num_inference_steps": 50,
+                "guidance_scale": 7.5,
+                "use_inv_latent": True,
+                "num_inv_steps": 50,
+            },
+            "learning_rate": learning_rate,
+            "gradient_accumulation_steps": gradient_accumulation,
+            "train_batch_size": 1,
+            "max_train_steps": n_steps,
+            "checkpointing_steps": checkpointing_steps,
+            "validation_steps": validation_steps,
+            "trainable_modules": ["attn1.to_q", "attn2.to_q", "attn_temp"],
+            "seed": seed,
+            "mixed_precision": mixed_precision,
+            "gradient_checkpointing": True,
+        }
+
+    def build_p2p_config(
+        self,
+        *,
+        output_dir: str,
+        video_path: str,
+        training_prompt: str,
+        editing_prompt: str,
+        blend_word_src: str = "",
+        blend_word_tgt: str = "",
+        eq_word: str = "",
+        eq_value: float = 2.0,
+        cross_replace_steps: float = 0.2,
+        self_replace_steps: float = 0.5,
+        save_name: str = "edit",
+        video_len: int = 8,
+    ) -> Dict:
+        """The Stage-2 config (trainer.py:232-276). Word-swap is inferred the
+        way the reference's UI does — equal prompt lengths (trainer.py:145-149)."""
+        cfg = {
+            "pretrained_model_path": output_dir,
+            "image_path": video_path,
+            "prompt": training_prompt,
+            "prompts": [training_prompt, editing_prompt],
+            "save_name": _slugify(save_name),
+            "is_word_swap": len(editing_prompt) == len(training_prompt),
+            "cross_replace_steps": cross_replace_steps,
+            "self_replace_steps": self_replace_steps,
+            "video_len": video_len,
+        }
+        if blend_word_src and blend_word_tgt:
+            cfg["blend_word"] = [blend_word_src, blend_word_tgt]
+        if eq_word:
+            cfg["eq_params"] = {"words": [eq_word], "values": [float(eq_value)]}
+        return cfg
+
+    def _launch(self, module: str, config_path: str, extra_flags: List[str]) -> int:
+        cmd = [sys.executable, "-m", module, "--config", config_path] + extra_flags
+        print("[ui]", " ".join(cmd))
+        return subprocess.call(cmd)
+
+    def run(self, *, output_model_name: str = "", extra_flags: Optional[List[str]] = None,
+            **kwargs) -> str:
+        """Stage-1 run: write config, launch the tuning CLI, drop a model
+        card. Returns the experiment dir."""
+        if not output_model_name:
+            output_model_name = datetime.datetime.now().strftime(
+                "video-p2p-%Y-%m-%d-%H-%M-%S"
+            )
+        exp_dir = self.experiments_dir / _slugify(output_model_name)
+        exp_dir.mkdir(parents=True, exist_ok=True)
+        cfg = self.build_tune_config(output_dir=exp_dir.as_posix(), **kwargs)
+        config_path = exp_dir / "train_config.yaml"
+        with open(config_path, "w") as f:
+            yaml.safe_dump(cfg, f, sort_keys=False)
+        ret = self._launch(
+            "videop2p_tpu.cli.run_tuning", config_path.as_posix(), extra_flags or []
+        )
+        if ret != 0:
+            raise RuntimeError(f"tuning failed with exit code {ret}")
+        save_model_card(
+            exp_dir.as_posix(),
+            base_model=cfg["pretrained_model_path"],
+            training_prompt=kwargs.get("training_prompt", ""),
+            test_prompt=kwargs.get("validation_prompt", ""),
+        )
+        return exp_dir.as_posix()
+
+    def run_p2p(self, *, fast: bool = True, extra_flags: Optional[List[str]] = None,
+                **kwargs) -> str:
+        """Stage-2 run against a finished experiment dir. Returns that dir."""
+        exp_dir = pathlib.Path(kwargs["output_dir"])
+        cfg = self.build_p2p_config(**kwargs)
+        config_path = exp_dir / "p2p_config.yaml"
+        with open(config_path, "w") as f:
+            yaml.safe_dump(cfg, f, sort_keys=False)
+        flags = list(extra_flags or [])
+        if fast:
+            flags.append("--fast")
+        ret = self._launch(
+            "videop2p_tpu.cli.run_videop2p", config_path.as_posix(), flags
+        )
+        if ret != 0:
+            raise RuntimeError(f"editing failed with exit code {ret}")
+        return exp_dir.as_posix()

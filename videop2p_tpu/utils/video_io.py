@@ -14,7 +14,7 @@ from typing import Optional
 
 import numpy as np
 
-__all__ = ["save_videos_grid", "to_uint8"]
+__all__ = ["save_videos_grid", "save_video_gif", "to_uint8"]
 
 
 def to_uint8(videos: np.ndarray) -> np.ndarray:
@@ -36,6 +36,18 @@ def make_grid(frames: np.ndarray, n_rows: int, pad: int = 2) -> np.ndarray:
         y, x = pad + r * (h + pad), pad + col * (w + pad)
         grid[y : y + h, x : x + w] = frames[i]
     return grid
+
+
+def save_video_gif(video: np.ndarray, path: str, *, fps: int = 4) -> str:
+    """Write one (F, H, W, C) video in [0, 1] as a looping GIF — the Stage-2
+    per-stream artifact (run_videop2p.py:698-701 writes each stream with
+    duration=250 ms, i.e. 4 fps)."""
+    import imageio
+
+    frames = list(to_uint8(video))
+    os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
+    imageio.mimsave(path, frames, duration=1000.0 / fps, loop=0)
+    return path
 
 
 def save_videos_grid(
