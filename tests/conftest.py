@@ -9,6 +9,12 @@ AFTER — this image's sitecustomize registers the `axon` TPU plugin in every
 interpreter and hard-sets ``jax_platforms="axon,cpu"`` via jax.config, which
 wins over the JAX_PLATFORMS env var, so only a later ``jax.config.update``
 actually selects the CPU backend.
+
+Compile-heavy tests dominate the suite's wall-clock; a persistent XLA
+compilation cache makes every run after the first fast. Tests relying on
+tight cross-run numerics opt into matmul precision locally via
+``jax.default_matmul_precision("highest")`` instead of a global override
+(which made every compile slower).
 """
 
 import os
@@ -20,4 +26,9 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_default_matmul_precision", "highest")
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.environ.get("VIDEOP2P_TEST_CACHE", "/root/.cache/videop2p_jax_test_cache"),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)

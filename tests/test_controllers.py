@@ -146,3 +146,38 @@ def test_control_attention_jittable_under_scan():
 
     _, sums = jax.lax.scan(body, 0.0, jnp.arange(STEPS))
     assert sums.shape == (STEPS,)
+
+
+def test_control_attention_asymmetric_uncond_layout():
+    """Fast mode drops the source-uncond stream: with U = P−1 uncond streams
+    the conditional edit must be identical to the symmetric layout's."""
+    ctx, _ = _ctx()
+    probs = _probs(jax.random.PRNGKey(8), 2 * P * F)
+    sym = control_attention(
+        probs, ctx, is_cross=True, step_index=jnp.asarray(2), video_length=F
+    )
+    # strip the source-uncond stream (stream 0) from the batch
+    asym_in = probs.reshape(2 * P, F, *probs.shape[1:])[1:].reshape(
+        -1, *probs.shape[1:]
+    )
+    asym = control_attention(
+        asym_in, ctx, is_cross=True, step_index=jnp.asarray(2), video_length=F,
+        num_uncond=P - 1,
+    )
+    np.testing.assert_allclose(
+        np.asarray(asym).reshape(2 * P - 1, F, *probs.shape[1:])[P - 1 :],
+        np.asarray(sym).reshape(2 * P, F, *probs.shape[1:])[P:],
+        rtol=1e-6,
+    )
+
+
+def test_spatial_replace_controller_is_attention_noop():
+    from videop2p_tpu.control import make_spatial_replace_controller
+
+    ctx = make_spatial_replace_controller(0.8, STEPS)
+    assert ctx.spatial_replace_until == int((1 - 0.8) * STEPS)
+    probs = _probs(jax.random.PRNGKey(9), 2 * P * F)
+    out = control_attention(
+        probs, ctx, is_cross=True, step_index=jnp.asarray(0), video_length=F
+    )
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(probs))

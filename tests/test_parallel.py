@@ -9,6 +9,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from videop2p_tpu.parallel import (
@@ -138,3 +140,79 @@ def test_param_shardings_tensor_parallel(mesh8):
     assert all(s == P() for s in convs) and convs
     # all kernels placeable
     jax.device_put(params, shardings)
+
+
+def test_ring_temporal_unet_forward(mesh8):
+    """UNet forward with ring attention at the temporal sites over the
+    frame-sharded mesh must equal the dense single-device forward (the
+    temporal_attention_fn seam, models/attention.py)."""
+    from videop2p_tpu.models import UNet3DConditionModel, UNet3DConfig
+    from videop2p_tpu.parallel import make_ring_temporal_fn
+
+    cfg = UNet3DConfig.tiny()
+    model = UNet3DConditionModel(config=cfg)
+    B, F = 1, 8
+    sample = jax.random.normal(jax.random.key(0), (B, F, 8, 8, 4))
+    text = jax.random.normal(jax.random.key(1), (B, 7, cfg.cross_attention_dim))
+    params = jax.jit(model.init)(jax.random.key(2), sample, jnp.asarray(5), text)
+    out_dense = jax.jit(model.apply)(params, sample, jnp.asarray(5), text)
+
+    model_ring = model.clone(temporal_attention_fn=make_ring_temporal_fn(mesh8))
+    s_sample = jax.device_put(sample, latent_sharding(mesh8))
+    s_text = jax.device_put(text, text_sharding(mesh8))
+    s_params = jax.device_put(params, replicated(mesh8))
+    out_ring = jax.jit(
+        model_ring.apply, out_shardings=latent_sharding(mesh8)
+    )(s_params, s_sample, jnp.asarray(5), s_text)
+    np.testing.assert_allclose(
+        np.asarray(out_dense), np.asarray(out_ring), atol=2e-4
+    )
+
+
+def test_sharded_controlled_edit_matches_unsharded(mesh8):
+    """The full attention-controlled edit (refine + equalizer + LocalBlend)
+    jitted over the frame-sharded mesh must match the single-device edit —
+    the Stage-2 --mesh path (cli/run_videop2p.py)."""
+    from videop2p_tpu.control import make_controller
+    from videop2p_tpu.core import DDIMScheduler
+    from videop2p_tpu.models import UNet3DConditionModel, UNet3DConfig
+    from videop2p_tpu.pipelines import edit_sample, make_unet_fn
+    from videop2p_tpu.utils.tokenizers import WordTokenizer
+
+    mesh = make_mesh((1, 4, 2))
+    cfg = UNet3DConfig.tiny()
+    model = UNet3DConditionModel(config=cfg)
+    F, STEPS = 4, 3
+    x_t = jax.random.normal(jax.random.key(0), (1, F, 8, 8, 4))
+    cond = jax.random.normal(jax.random.key(1), (2, 77, cfg.cross_attention_dim))
+    uncond = jnp.zeros((77, cfg.cross_attention_dim))
+    params = jax.jit(model.init)(jax.random.key(2), x_t, jnp.asarray(5), cond[:1])
+    fn = make_unet_fn(model)
+    sched = DDIMScheduler.create_sd()
+    ctx = make_controller(
+        ["a rabbit is jumping", "a origami rabbit is jumping"],
+        WordTokenizer(), num_steps=STEPS,
+        is_replace_controller=False,
+        cross_replace_steps=0.8, self_replace_steps=0.6,
+        blend_words=(["rabbit"], ["rabbit"]),
+        equalizer_params={"words": ["origami"], "values": [2.0]},
+    )
+
+    def run(p, xt, c, u):
+        return edit_sample(
+            fn, p, sched, xt, c, u, num_inference_steps=STEPS, ctx=ctx,
+            source_uses_cfg=False, blend_res=(4, 4),
+        )
+
+    out_single = jax.jit(run)(params, x_t, cond, uncond)
+
+    s_params = jax.device_put(
+        params, param_shardings(mesh, params, tensor_parallel=True)
+    )
+    s_xt = jax.device_put(x_t, latent_sharding(mesh))
+    s_cond = jax.device_put(cond, replicated(mesh))
+    s_uncond = jax.device_put(uncond, replicated(mesh))
+    out_sharded = jax.jit(run)(s_params, s_xt, s_cond, s_uncond)
+    np.testing.assert_allclose(
+        np.asarray(out_single), np.asarray(out_sharded), atol=2e-4
+    )

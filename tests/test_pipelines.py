@@ -11,6 +11,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from videop2p_tpu.control import make_controller
 from videop2p_tpu.core import DDIMScheduler
 from videop2p_tpu.models import UNet3DConditionModel, UNet3DConfig
@@ -245,3 +247,68 @@ def test_null_text_dependent_mode(sched):
     with pytest.raises(ValueError, match="requires dependent_sampler"):
         null_text_optimization(fn, None, sched, traj, cond, uncond,
                                num_inference_steps=STEPS, dependent_weight=0.3)
+
+
+def test_spatial_replace_injects_source_latents(sched, tiny):
+    """SpatialReplace (run_videop2p.py:235-246): while step < stop bound the
+    edit stream's latents are the source stream's; afterwards they evolve
+    freely, so with stop_inject=1.0 (never inject) streams differ."""
+    from videop2p_tpu.control import make_spatial_replace_controller
+
+    fn, params, cfg = tiny
+    cond1 = jax.random.normal(jax.random.key(4), (1, 77, cfg.cross_attention_dim))
+    cond = jnp.concatenate([cond1, cond1 + 0.5], axis=0)
+    uncond = jnp.zeros((77, cfg.cross_attention_dim))
+    x_t = jax.random.normal(jax.random.key(5), SHAPE)
+
+    ctx_full = make_spatial_replace_controller(0.0, STEPS)  # inject every step
+    out_full = jax.jit(
+        lambda xt: edit_sample(
+            fn, params, sched, xt, cond, uncond,
+            num_inference_steps=STEPS, ctx=ctx_full,
+        )
+    )(x_t)
+    np.testing.assert_allclose(
+        np.asarray(out_full[1]), np.asarray(out_full[0]), atol=1e-5
+    )
+
+    ctx_off = make_spatial_replace_controller(1.0, STEPS)  # never inject
+    out_off = jax.jit(
+        lambda xt: edit_sample(
+            fn, params, sched, xt, cond, uncond,
+            num_inference_steps=STEPS, ctx=ctx_off,
+        )
+    )(x_t)
+    assert not np.allclose(np.asarray(out_off[1]), np.asarray(out_off[0]), atol=1e-5)
+
+
+def test_multi_frame_embeddings_match_shared(sched, tiny):
+    """Per-frame ("multi") conditioning (pipeline_tuneavideo.py:366-367):
+    frame-constant 4-D embeddings must reproduce the 3-D path exactly, and
+    per-frame-varying embeddings must change the output."""
+    fn, params, cfg = tiny
+    F = SHAPE[1]
+    cond = jax.random.normal(jax.random.key(6), (2, 77, cfg.cross_attention_dim))
+    uncond = jnp.zeros((77, cfg.cross_attention_dim))
+    x_t = jax.random.normal(jax.random.key(7), SHAPE)
+
+    out3 = jax.jit(
+        lambda xt: edit_sample(
+            fn, params, sched, xt, cond, uncond, num_inference_steps=STEPS,
+        )
+    )(x_t)
+    cond4 = jnp.repeat(cond[:, None], F, axis=1)  # (P, F, 77, D)
+    out4 = jax.jit(
+        lambda xt: edit_sample(
+            fn, params, sched, xt, cond4, uncond, num_inference_steps=STEPS,
+        )
+    )(x_t)
+    np.testing.assert_allclose(np.asarray(out4), np.asarray(out3), atol=1e-4)
+
+    cond4v = cond4.at[:, 1:].add(0.5)  # vary later frames
+    out4v = jax.jit(
+        lambda xt: edit_sample(
+            fn, params, sched, xt, cond4v, uncond, num_inference_steps=STEPS,
+        )
+    )(x_t)
+    assert not np.allclose(np.asarray(out4v), np.asarray(out3), atol=1e-4)

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import zlib
 
 import numpy as np
 from PIL import Image, ImageDraw
@@ -37,7 +38,7 @@ def draw_frame(scene: str, t: int, num_frames: int, size: int) -> Image.Image:
     d.rectangle([0, 0, size, horizon], fill=top)
     d.rectangle([0, horizon, size, size], fill=bottom)
     # textured background stripes so inversion has structure to reconstruct
-    rng = np.random.default_rng(hash(scene) % (2**32))
+    rng = np.random.default_rng(zlib.crc32(scene.encode()))
     for _ in range(12):
         x = int(rng.uniform(0, size))
         w = int(rng.uniform(8, 30))

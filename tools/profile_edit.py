@@ -1,0 +1,138 @@
+"""One-off profiling harness: where does the fast-edit wall-clock go?
+
+Measures on the attached accelerator: (a) single UNet forward at the
+inversion batch (cond-only, P=1) and the edit CFG batch (2P=4) with and
+without control, (b) the jitted 50-step inversion and edit scans, and
+(c) XLA's own FLOP estimate per executable for an MFU readout.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+from videop2p_tpu.control import make_controller
+from videop2p_tpu.core import DDIMScheduler
+from videop2p_tpu.models import UNet3DConditionModel, UNet3DConfig
+from videop2p_tpu.models.attention import AttnControl
+from videop2p_tpu.pipelines import ddim_inversion, edit_sample, make_unet_fn
+from videop2p_tpu.utils.tokenizers import WordTokenizer
+
+V5E_PEAK_FLOPS = 197e12  # bf16
+
+
+def timed(fn, *args, n=3):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.time()
+    for _ in range(n):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.time() - t0) / n
+
+
+def flops_of(jitted, *args):
+    try:
+        an = jitted.lower(*args).compile().cost_analysis()
+        if isinstance(an, list):
+            an = an[0]
+        return float(an.get("flops", 0.0))
+    except Exception as e:  # pragma: no cover
+        print("cost_analysis failed:", e)
+        return 0.0
+
+
+def main():
+    cfg = UNet3DConfig.sd15()
+    model = UNet3DConditionModel(config=cfg, dtype=jnp.bfloat16)
+    F, STEPS = 8, 50
+    x0 = jax.random.normal(jax.random.key(0), (1, F, 64, 64, 4), jnp.bfloat16)
+    cond = jax.random.normal(jax.random.key(1), (2, 77, 768), jnp.bfloat16)
+    uncond = jnp.zeros((77, 768), jnp.bfloat16)
+    params = jax.jit(model.init)(jax.random.key(2), x0, jnp.asarray(10), cond[:1])
+    fn = make_unet_fn(model)
+    sched = DDIMScheduler.create_sd()
+
+    ctx = make_controller(
+        ["a rabbit is jumping on the grass", "a origami rabbit is jumping on the grass"],
+        WordTokenizer(),
+        num_steps=STEPS,
+        is_replace_controller=False,
+        cross_replace_steps=0.2,
+        self_replace_steps=0.5,
+        blend_words=(["rabbit"], ["rabbit"]),
+        equalizer_params={"words": ["origami"], "values": [2.0]},
+    )
+
+    # --- single forwards -------------------------------------------------
+    t = jnp.asarray(801)
+    fwd1 = jax.jit(lambda p, x: fn(p, x, t, cond[:1])[0])
+    x4 = jnp.concatenate([x0, x0, x0, x0], axis=0)
+    text4 = jnp.concatenate([uncond[None], uncond[None], cond], axis=0)
+    fwd4 = jax.jit(lambda p, x: fn(p, x, t, text4)[0])
+    ctl = AttnControl(ctx=ctx, step_index=jnp.asarray(5))
+    fwd4c = jax.jit(lambda p, x: fn(p, x, t, text4, ctl)[0])
+    x3 = x4[:3]
+    text3 = jnp.concatenate([uncond[None], cond], axis=0)
+    fwd3 = jax.jit(lambda p, x: fn(p, x, t, text3)[0])
+
+    ctl3 = AttnControl(ctx=ctx, step_index=jnp.asarray(5), num_uncond=1)
+    fwd3c = jax.jit(lambda p, x: fn(p, x, t, text3, ctl3)[0])
+
+    # frame-attention impl ablation at the edit batch
+    abl = []
+    if "ablate" in sys.argv:
+        for impl in ("flash", "chunked"):
+            m2 = UNet3DConditionModel(
+                config=UNet3DConfig.sd15(frame_attention=impl), dtype=jnp.bfloat16
+            )
+            f2 = make_unet_fn(m2)
+            abl.append((f"fwd b4 [{impl}]", jax.jit(lambda p, x, f2=f2: f2(p, x, t, text4)[0]), x4))
+
+    for name, f, xin in [
+        ("fwd b1 (inversion step)", fwd1, x0),
+        ("fwd b3", fwd3, x3),
+        ("fwd b3 + control", fwd3c, x3),
+        ("fwd b4 (edit step)", fwd4, x4),
+        ("fwd b4 + control", fwd4c, x4),
+    ] + abl:
+        dt = timed(f, params, xin)
+        fl = flops_of(f, params, xin)
+        mfu = fl / dt / V5E_PEAK_FLOPS if dt else 0.0
+        print(f"{name:28s}: {dt*1e3:8.2f} ms  {fl/1e12:7.2f} TF  MFU {mfu*100:5.1f}%")
+
+    if "phases" not in sys.argv:
+        return
+
+    # --- full phases (FLOPs estimated as 50 × single-step) ---------------
+    invert = jax.jit(
+        lambda p, x: ddim_inversion(fn, p, sched, x, cond[:1], num_inference_steps=STEPS)
+    )
+    edit = jax.jit(
+        lambda p, xt: edit_sample(
+            fn, p, sched, xt, cond, uncond,
+            num_inference_steps=STEPS, ctx=ctx, source_uses_cfg=False,
+        )
+    )
+    t0 = time.time()
+    traj = invert(params, x0)
+    jax.block_until_ready(traj)
+    print(f"inversion compile+run: {time.time()-t0:.1f} s")
+    xt = traj[-1]
+    t0 = time.time()
+    out = edit(params, xt)
+    jax.block_until_ready(out)
+    print(f"edit compile+run: {time.time()-t0:.1f} s")
+    for name, f, xin in [
+        ("inversion 50 (b1)", invert, x0),
+        ("edit 50 (b4, ctrl+blend)", edit, xt),
+    ]:
+        dt = timed(f, params, xin, n=1)
+        print(f"{name:28s}: {dt:8.3f} s   per-step {dt/STEPS*1e3:7.1f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -22,6 +22,7 @@ __all__ = [
     "load_config",
     "add_dependent_args",
     "dependent_suffix",
+    "resolve_pipeline_dir",
     "build_models",
     "encode_prompts",
     "ModelBundle",
@@ -65,6 +66,34 @@ def dependent_suffix(
     )
 
 
+def _is_pipeline_dir(path: str) -> bool:
+    return os.path.isdir(os.path.join(path, "unet")) or os.path.isfile(
+        os.path.join(path, "model_index.json")
+    )
+
+
+def resolve_pipeline_dir(base_path: str, **suffix_kwargs) -> str:
+    """Apply the Stage-1↔Stage-2 suffix contract, tolerating already-resolved
+    dirs.
+
+    The reference blindly appends the suffix (run_videop2p.py:74-78), which
+    breaks when the caller (e.g. the demo UI's experiment picker) already
+    holds the suffixed pipeline dir — the doubled path doesn't exist and
+    model loading silently fell back to random init. Preference order:
+    suffixed dir if it holds a pipeline, else the given dir if it does, else
+    the suffixed dir (downstream loading warns about the missing checkpoint).
+    """
+    suffixed = base_path + dependent_suffix(**suffix_kwargs)
+    if _is_pipeline_dir(suffixed):
+        return suffixed
+    if _is_pipeline_dir(base_path):
+        if suffixed != base_path:
+            print(f"[resolve_pipeline_dir] {base_path!r} is already a pipeline "
+                  "dir — not appending the dependent suffix")
+        return base_path
+    return suffixed
+
+
 @dataclass
 class ModelBundle:
     unet: Any
@@ -76,6 +105,16 @@ class ModelBundle:
     tokenizer: Any
     random_init: bool
     source_dir: Optional[str]
+    # the checkpoint's scheduler_config.json (empty for random init) — Stage-2
+    # builds its DDIM scheduler from this (run_videop2p.py:101-114)
+    scheduler_config: Optional[Dict] = None
+
+    def make_scheduler(self):
+        from videop2p_tpu.core import DDIMScheduler
+
+        if self.scheduler_config:
+            return DDIMScheduler.from_config(self.scheduler_config)
+        return DDIMScheduler.create_sd()
 
 
 def build_models(
@@ -131,6 +170,7 @@ def build_models(
             tokenizer=tokenizer,
             random_init=False,
             source_dir=pretrained_model_path,
+            scheduler_config=loaded.scheduler_config,
         )
 
     warnings.warn(
@@ -154,7 +194,6 @@ def build_models(
     s = ucfg.sample_size
     probe = jnp.zeros((1, 2, s, s, ucfg.in_channels), dtype)
     tprobe = jnp.zeros((1, 77, ucfg.cross_attention_dim), dtype)
-    px = 8 * s if not tiny else 8 * s
     unet_params = jax.jit(unet.init)(key, probe, jnp.asarray(0), tprobe)
     vae_params = jax.jit(vae.init)(key, jnp.zeros((1, 64, 64, vcfg.in_channels), dtype), key)
     text_params = jax.jit(text_encoder.init)(key, jnp.zeros((1, 8), jnp.int32))

@@ -25,12 +25,12 @@ import numpy as np
 from videop2p_tpu.cli.common import (
     add_dependent_args,
     build_models,
-    dependent_suffix,
     encode_prompts,
     load_config,
+    resolve_pipeline_dir,
 )
 from videop2p_tpu.control import make_controller
-from videop2p_tpu.core import DDIMScheduler, DependentNoiseSampler
+from videop2p_tpu.core import DependentNoiseSampler
 from videop2p_tpu.data import load_frame_sequence
 from videop2p_tpu.models import decode_video, encode_video
 from videop2p_tpu.pipelines import (
@@ -72,6 +72,12 @@ def main(
     ar_coeff: float = 0.1,
     eta: float = 0.0,
     dependent_weights: float = 0.0,
+    # per-frame text-embedding mode (pipeline_tuneavideo.py:341,366-367)
+    multi: bool = False,
+    # device mesh "dp,sp,tp" — shards the edit across chips: frames over sp
+    # (sequence parallel, ring attention on uncontrolled temporal sites),
+    # attention/FF kernels over tp. Single-video Stage-2 needs dp=1.
+    mesh: Optional[str] = None,
     # extras (not in the reference)
     tiny: bool = False,
     width: int = 512,
@@ -87,8 +93,10 @@ def main(
         width = 16
     # Stage-1 ↔ Stage-2 path contract: the tuning run mangled its output dir
     # with the dependent hyperparameters (run_videop2p.py:74-78); results land
-    # inside the checkpoint dir under results_dp{dependent_p2p} (:79)
-    pretrained_model_path = pretrained_model_path + dependent_suffix(
+    # inside the checkpoint dir under results_dp{dependent_p2p} (:79).
+    # Already-suffixed dirs (e.g. from the demo UI's picker) pass through.
+    pretrained_model_path = resolve_pipeline_dir(
+        pretrained_model_path,
         dependent=dependent, decay_rate=decay_rate, window_size=window_size,
         ar_sample=ar_sample, ar_coeff=ar_coeff, eta=eta,
         dependent_weights=dependent_weights,
@@ -115,10 +123,47 @@ def main(
     bundle = build_models(
         pretrained_model_path, dtype=dtype, frame_attention="chunked", tiny=tiny,
         seed=seed,
+        # full mode differentiates through the UNet (null-text optimization);
+        # per-block remat keeps that backward inside one chip's HBM
+        gradient_checkpointing=not fast,
     )
+    device_mesh = None
+    if mesh:
+        from videop2p_tpu.parallel import (
+            make_mesh,
+            make_ring_temporal_fn,
+            param_shardings,
+        )
+
+        shape = tuple(int(t) for t in str(mesh).split(","))
+        if len(shape) != 3:
+            raise ValueError(f"--mesh must be dp,sp,tp — got {mesh!r}")
+        dp, sp, tp = shape
+        if dp != 1:
+            raise ValueError(
+                "Stage-2 edits one video (batch 1 through inversion) — use "
+                f"dp=1 and put chips on the frame/tensor axes, got dp={dp}"
+            )
+        if video_len % sp:
+            raise ValueError(f"video_len {video_len} must divide the sp axis {sp}")
+        device_mesh = make_mesh(shape)
+        print(f"[p2p] mesh: data={dp} frames={sp} tensor={tp}")
+        if sp > 1:
+            # ring attention on the uncontrolled temporal sites (inversion /
+            # null-text); controlled sites stay dense for the P2P edit
+            bundle.unet = bundle.unet.clone(
+                temporal_attention_fn=make_ring_temporal_fn(device_mesh)
+            )
+        bundle.unet_params = jax.device_put(
+            bundle.unet_params,
+            param_shardings(device_mesh, bundle.unet_params, tensor_parallel=tp > 1),
+        )
+
     unet_fn = make_unet_fn(bundle.unet)
     params = bundle.unet_params
-    sched = DDIMScheduler.create_sd()
+    # the tuned pipeline's own scheduler config (incl. the steps_offset: 1 the
+    # Stage-1 export writes), not hardcoded SD defaults (run_videop2p.py:101-114)
+    sched = bundle.make_scheduler()
     key = jax.random.key(seed)
 
     # ---- load + encode the video ----------------------------------------
@@ -131,10 +176,22 @@ def main(
             bundle.vae, bundle.vae_params, video.astype(dtype), key, sample=False
         )
         latents = jax.block_until_ready(latents.astype(jnp.float32))
+    if device_mesh is not None:
+        from videop2p_tpu.parallel import latent_sharding
+
+        # frames ride the sp axis; the inversion/edit jits below then compute
+        # sequence-parallel with XLA-inserted collectives over ICI
+        latents = jax.device_put(latents, latent_sharding(device_mesh))
 
     cond_src = encode_prompts(bundle, [prompt])
     cond_all = encode_prompts(bundle, list(prompts))
     uncond = encode_prompts(bundle, [""])[0]
+    if multi:
+        # per-frame conditioning: repeat each prompt embedding across frames
+        # (the reference's `repeat(text_embeddings, 'b n c -> (b f) n c')`,
+        # pipeline_tuneavideo.py:366-367); downstream consumers may then vary
+        # embeddings per frame
+        cond_all = jnp.repeat(cond_all[:, None], video_len, axis=1)
 
     # ---- DDIM inversion (+ null-text in full mode) ----------------------
     dep_w = dependent_weights if dependent_p2p else 0.0
@@ -222,10 +279,18 @@ if __name__ == "__main__":
     parser.add_argument("--dependent_p2p", default=False, action="store_true")
     parser.add_argument("--tiny", action="store_true",
                         help="random-init tiny models (weightless smoke mode)")
+    parser.add_argument("--mesh", type=str, default=None,
+                        help="device mesh dp,sp,tp (e.g. 1,4,1: frames over 4 chips)")
+    parser.add_argument("--multi", action="store_true",
+                        help="per-frame text-embedding mode")
     add_dependent_args(parser)
     args = parser.parse_args()
+    cfg = load_config(args.config)
+    # flags win over config for the keys both surfaces expose
+    args.multi = args.multi or bool(cfg.pop("multi", False))
+    args.mesh = args.mesh or cfg.pop("mesh", None)
     main(
-        **load_config(args.config),
+        **cfg,
         fast=args.fast,
         dependent=args.dependent,
         dependent_p2p=args.dependent_p2p,
@@ -237,4 +302,6 @@ if __name__ == "__main__":
         eta=args.eta,
         dependent_weights=args.dependent_weights,
         tiny=args.tiny,
+        mesh=args.mesh,
+        multi=args.multi,
     )

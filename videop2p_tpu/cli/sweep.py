@@ -76,8 +76,12 @@ def main(argv=None) -> int:
     ap.add_argument("--skip_tune", action="store_true",
                     help="reuse existing Stage-1 checkpoints, only re-edit")
     ap.add_argument("--dry_run", action="store_true", help="print commands only")
-    ap.add_argument("extra", nargs="*", help="extra flags passed to both stages")
-    args = ap.parse_args(argv)
+    # everything the sweep doesn't recognize is forwarded to both stages in
+    # original order (flag-style extras like `--tiny` or `--width 256` work
+    # without a `--` separator; a positional catch-all would split a flag
+    # from its value)
+    args, unknown = ap.parse_known_args(argv)
+    args.extra = unknown
 
     tune_config = args.tune_config or f"configs/{args.scene}-tune.yaml"
     p2p_config = args.p2p_config or f"configs/{args.scene}-p2p.yaml"

@@ -10,7 +10,9 @@ from videop2p_tpu.control.schedules import (
 )
 from videop2p_tpu.control.controllers import (
     ControlContext,
+    get_equalizer,
     make_controller,
+    make_spatial_replace_controller,
     control_attention,
 )
 from videop2p_tpu.control.local_blend import LocalBlendConfig, make_local_blend, local_blend
@@ -21,7 +23,9 @@ __all__ = [
     "get_word_inds",
     "get_time_words_attention_alpha",
     "ControlContext",
+    "get_equalizer",
     "make_controller",
+    "make_spatial_replace_controller",
     "control_attention",
     "LocalBlendConfig",
     "make_local_blend",

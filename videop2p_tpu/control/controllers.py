@@ -37,7 +37,13 @@ from videop2p_tpu.control.local_blend import LocalBlendConfig, make_local_blend
 from videop2p_tpu.control.schedules import get_time_words_attention_alpha, get_word_inds
 from videop2p_tpu.utils.tokenizers import MAX_NUM_WORDS, Tokenizer
 
-__all__ = ["ControlContext", "make_controller", "control_attention", "get_equalizer"]
+__all__ = [
+    "ControlContext",
+    "make_controller",
+    "make_spatial_replace_controller",
+    "control_attention",
+    "get_equalizer",
+]
 
 
 class ControlContext(struct.PyTreeNode):
@@ -54,9 +60,15 @@ class ControlContext(struct.PyTreeNode):
     equalizer: Optional[jax.Array] = None  # (n_edits, 77)
     blend: Optional[LocalBlendConfig] = None
 
+    # "replace" | "refine" | "empty" (no attention edit — the reference's
+    # EmptyControl/SpatialReplace base, run_videop2p.py:183,235)
     kind: str = struct.field(pytree_node=False, default="refine")
     num_prompts: int = struct.field(pytree_node=False, default=2)
     self_replace_range: Tuple[int, int] = struct.field(pytree_node=False, default=(0, 0))
+    # SpatialReplace (run_videop2p.py:235-246): while step < this bound the
+    # edited streams' latents are overwritten with the source stream's after
+    # each scheduler step; 0 disables
+    spatial_replace_until: int = struct.field(pytree_node=False, default=0)
 
     @property
     def n_edits(self) -> int:
@@ -150,6 +162,26 @@ def make_controller(
     )
 
 
+def make_spatial_replace_controller(
+    stop_inject: float,
+    num_steps: int,
+    *,
+    num_prompts: int = 2,
+) -> ControlContext:
+    """SpatialReplace (run_videop2p.py:235-246): no attention edits; for the
+    first ``int((1 − stop_inject)·num_steps)`` steps every edited stream's
+    latent is replaced with the source stream's after the scheduler step."""
+    return ControlContext(
+        cross_replace_alpha=jnp.zeros(
+            (num_steps + 1, max(num_prompts - 1, 1), 1, 1, MAX_NUM_WORDS)
+        ),
+        kind="empty",
+        num_prompts=num_prompts,
+        self_replace_range=(0, 0),
+        spatial_replace_until=int((1.0 - stop_inject) * num_steps),
+    )
+
+
 # --------------------------------------------------------------------- #
 # edit functions (operate on the conditional half)
 # --------------------------------------------------------------------- #
@@ -198,36 +230,44 @@ def control_attention(
     is_cross: bool,
     step_index: jax.Array,
     video_length: int,
+    num_uncond: int = -1,
 ) -> jax.Array:
     """Apply the edit to full-batch attention probabilities.
 
     Layouts (uncond streams first, matching the CFG batch of
-    pipeline_tuneavideo.py:235):
-      cross:    (2·P·F, H, Q, W)  — frames folded into batch
-      temporal: (2·P·D, H, F, F)  — spatial positions folded into batch
-    Only the conditional half is edited (run_videop2p.py:217-218).
+    pipeline_tuneavideo.py:235), with U uncond + P cond streams:
+      cross:    ((U+P)·F, H, Q, W)  — frames folded into batch
+      temporal: ((U+P)·D, H, F, F)  — spatial positions folded into batch
+    Only the conditional streams are edited (run_videop2p.py:217-218). The
+    default U = P is the reference's CFG batch; fast mode drops the source
+    stream's unused uncond (U = P−1), and cond-only forwards pass U = 0.
     """
-    if ctx is None:
+    if ctx is None or ctx.kind == "empty":
         return probs
     P = ctx.num_prompts
+    U = ctx.num_prompts if num_uncond < 0 else num_uncond
     B, H, Q, K = probs.shape
-    inner = B // (2 * P)  # F for cross sites, D (=h·w) for temporal sites
+    if B % (U + P):
+        raise ValueError(
+            f"attention batch {B} does not factor into {U} uncond + {P} cond streams"
+        )
+    inner = B // (U + P)  # F for cross sites, D (=h·w) for temporal sites
     if is_cross and inner != video_length:
         raise ValueError(
-            f"cross-attention batch {B} does not factor as 2·{P}·{video_length} "
-            "(uncond+cond × prompts × frames) — batch layout mismatch"
+            f"cross-attention batch {B} does not factor as ({U}+{P})·{video_length} "
+            "(uncond+cond streams × frames) — batch layout mismatch"
         )
     if not is_cross and (Q != video_length or K != video_length):
         raise ValueError(
             f"temporal attention maps must be ({video_length}×{video_length}), got ({Q}×{K})"
         )
 
-    split = probs.reshape(2, P, inner, H, Q, K)
-    cond = split[1]
+    split = probs.reshape(U + P, inner, H, Q, K)
+    cond = split[U:]
     if is_cross:
         edited = _edit_cross(cond, ctx, step_index)
     else:
         # temporal layout folds spatial positions; move them next to heads
         edited = _edit_temporal(cond, ctx, step_index)
-    out = jnp.stack([split[0], edited], axis=0)
+    out = jnp.concatenate([split[:U], edited], axis=0)
     return out.reshape(B, H, Q, K)

@@ -108,6 +108,19 @@ class DDIMScheduler(struct.PyTreeNode):
         )
 
     @classmethod
+    def from_config(cls, config) -> "DDIMScheduler":
+        """Build from a diffusers ``scheduler_config.json`` dict — the Stage-2
+        path loads the tuned pipeline's scheduler instead of assuming SD
+        defaults (run_videop2p.py:101-114; notably the Stage-1 export writes
+        ``steps_offset: 1``). Unknown keys are ignored."""
+        known = (
+            "num_train_timesteps", "beta_start", "beta_end", "beta_schedule",
+            "clip_sample", "set_alpha_to_one", "steps_offset", "prediction_type",
+        )
+        kwargs = {k: config[k] for k in known if k in config}
+        return cls.create(**kwargs)
+
+    @classmethod
     def create_sd(cls, **overrides) -> "DDIMScheduler":
         """The Stable-Diffusion configuration used throughout the reference
         (run_videop2p.py:30)."""

@@ -57,6 +57,10 @@ class AttnControl(struct.PyTreeNode):
 
     ctx: ControlContext
     step_index: jax.Array  # () int32
+    # uncond streams ahead of the ctx.num_prompts cond streams in the batch;
+    # -1 → ctx.num_prompts (the symmetric CFG layout). Fast mode drops the
+    # source stream's unused uncond forward (num_uncond = num_prompts − 1).
+    num_uncond: int = struct.field(pytree_node=False, default=-1)
 
 
 def _split_heads(x: jax.Array, heads: int) -> jax.Array:
@@ -139,6 +143,11 @@ class ControlledAttention(nn.Module):
     site: str  # "cross" | "temporal"
     zero_init_out: bool = False
     dtype: Dtype = jnp.float32
+    # sequence-parallel kernel for UNCONTROLLED passes, e.g. ring attention
+    # over a sharded frame axis ((q, k, v) (B, H, N, D) → out). Controlled
+    # passes need materialized probabilities (SURVEY §7 hard-part 2), so a
+    # non-None ``control`` always takes the dense path.
+    attention_fn: Optional[Callable[[jax.Array, jax.Array, jax.Array], jax.Array]] = None
 
     @nn.compact
     def __call__(
@@ -155,6 +164,13 @@ class ControlledAttention(nn.Module):
         k = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_k")(ctx_in)
         v = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_v")(ctx_in)
         q, k, v = (_split_heads(t, self.heads) for t in (q, k, v))
+
+        if self.attention_fn is not None and control is None:
+            out = self.attention_fn(q, k, v)
+            out = _merge_heads(out)
+            kernel_init = nn.initializers.zeros if self.zero_init_out else None
+            kwargs = {"kernel_init": kernel_init} if kernel_init is not None else {}
+            return nn.Dense(inner, dtype=self.dtype, name="to_out", **kwargs)(out)
 
         sim = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (self.dim_head ** -0.5)
         probs = _stable_softmax(sim, self.dtype)
@@ -178,6 +194,7 @@ class ControlledAttention(nn.Module):
                 is_cross=(self.site == "cross"),
                 step_index=control.step_index,
                 video_length=video_length,
+                num_uncond=control.num_uncond,
             )
 
         out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
@@ -214,6 +231,9 @@ class BasicTransformerBlock(nn.Module):
     dim_head: int
     dtype: Dtype = jnp.float32
     frame_attention_fn: Optional[Callable] = None
+    # sequence-parallel temporal kernel (ring attention) for uncontrolled
+    # passes over a sharded frame axis
+    temporal_attention_fn: Optional[Callable] = None
 
     @nn.compact
     def __call__(
@@ -255,7 +275,8 @@ class BasicTransformerBlock(nn.Module):
         h = h.transpose(0, 2, 1, 3).reshape(b * n, f, c)
         attn_temp = ControlledAttention(
             heads=self.heads, dim_head=self.dim_head, site="temporal",
-            zero_init_out=True, dtype=self.dtype, name="attn_temp",
+            zero_init_out=True, dtype=self.dtype,
+            attention_fn=self.temporal_attention_fn, name="attn_temp",
         )(h, control=control, video_length=f)
         x = x + attn_temp.reshape(b, n, f, c).transpose(0, 2, 1, 3)
         return x
@@ -272,6 +293,7 @@ class Transformer3DModel(nn.Module):
     norm_groups: int = 32
     dtype: Dtype = jnp.float32
     frame_attention_fn: Optional[Callable] = None
+    temporal_attention_fn: Optional[Callable] = None
 
     @nn.compact
     def __call__(
@@ -301,6 +323,7 @@ class Transformer3DModel(nn.Module):
             h = BasicTransformerBlock(
                 dim=inner, heads=self.heads, dim_head=self.dim_head,
                 dtype=self.dtype, frame_attention_fn=self.frame_attention_fn,
+                temporal_attention_fn=self.temporal_attention_fn,
                 name=f"blocks_{i}",
             )(h, context=context, control=control)
 

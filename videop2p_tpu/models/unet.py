@@ -120,6 +120,10 @@ class UNet3DConditionModel(nn.Module):
     config: UNet3DConfig
     dtype: jnp.dtype = jnp.float32
     frame_attention_fn: Optional[Callable] = None
+    # sequence-parallel temporal kernel (e.g. parallel.make_ring_temporal_fn
+    # over a frame-sharded mesh); uncontrolled passes only — controlled sites
+    # keep dense probabilities for the P2P edit
+    temporal_attention_fn: Optional[Callable] = None
 
     @nn.compact
     def __call__(
@@ -169,6 +173,7 @@ class UNet3DConditionModel(nn.Module):
                 norm_groups=cfg.norm_num_groups,
                 dtype=self.dtype,
                 frame_attention_fn=frame_attention_fn,
+                temporal_attention_fn=self.temporal_attention_fn,
                 name=f"down_blocks_{i}",
             )
             if block_type == "CrossAttnDownBlock3D":
@@ -190,6 +195,7 @@ class UNet3DConditionModel(nn.Module):
             norm_groups=cfg.norm_num_groups,
             dtype=self.dtype,
             frame_attention_fn=frame_attention_fn,
+            temporal_attention_fn=self.temporal_attention_fn,
             name="mid_block",
         )(x, temb, encoder_hidden_states, control)
 
@@ -213,6 +219,7 @@ class UNet3DConditionModel(nn.Module):
                 norm_groups=cfg.norm_num_groups,
                 dtype=self.dtype,
                 frame_attention_fn=frame_attention_fn,
+                temporal_attention_fn=self.temporal_attention_fn,
                 name=f"up_blocks_{i}",
             )
             if block_type == "CrossAttnUpBlock3D":

@@ -48,6 +48,7 @@ class CrossAttnDownBlock3D(nn.Module):
     norm_groups: int = 32
     dtype: Dtype = jnp.float32
     frame_attention_fn: Optional[Callable] = None
+    temporal_attention_fn: Optional[Callable] = None
 
     @nn.compact
     def __call__(
@@ -70,6 +71,7 @@ class CrossAttnDownBlock3D(nn.Module):
                 norm_groups=self.norm_groups,
                 dtype=self.dtype,
                 frame_attention_fn=self.frame_attention_fn,
+                temporal_attention_fn=self.temporal_attention_fn,
                 name=f"attentions_{i}",
             )(x, context=context, control=control)
             outputs.append(x)
@@ -115,6 +117,7 @@ class UNetMidBlock3DCrossAttn(nn.Module):
     norm_groups: int = 32
     dtype: Dtype = jnp.float32
     frame_attention_fn: Optional[Callable] = None
+    temporal_attention_fn: Optional[Callable] = None
 
     @nn.compact
     def __call__(
@@ -135,6 +138,7 @@ class UNetMidBlock3DCrossAttn(nn.Module):
                 norm_groups=self.norm_groups,
                 dtype=self.dtype,
                 frame_attention_fn=self.frame_attention_fn,
+                temporal_attention_fn=self.temporal_attention_fn,
                 name=f"attentions_{i}",
             )(x, context=context, control=control)
             x = ResnetBlock3D(
@@ -156,6 +160,7 @@ class CrossAttnUpBlock3D(nn.Module):
     norm_groups: int = 32
     dtype: Dtype = jnp.float32
     frame_attention_fn: Optional[Callable] = None
+    temporal_attention_fn: Optional[Callable] = None
 
     @nn.compact
     def __call__(
@@ -179,6 +184,7 @@ class CrossAttnUpBlock3D(nn.Module):
                 norm_groups=self.norm_groups,
                 dtype=self.dtype,
                 frame_attention_fn=self.frame_attention_fn,
+                temporal_attention_fn=self.temporal_attention_fn,
                 name=f"attentions_{i}",
             )(x, context=context, control=control)
         if self.add_upsample:
@@ -213,7 +219,9 @@ class UpBlock3D(nn.Module):
         return x
 
 
-_ATTN_ONLY_KWARGS = ("transformer_depth", "attn_heads", "frame_attention_fn")
+_ATTN_ONLY_KWARGS = (
+    "transformer_depth", "attn_heads", "frame_attention_fn", "temporal_attention_fn",
+)
 
 
 def _make(mod_cls, remat: bool, kwargs):

@@ -11,7 +11,11 @@ from videop2p_tpu.parallel.mesh import (
     shard_array,
     text_sharding,
 )
-from videop2p_tpu.parallel.ring import ring_attention, ring_attention_sharded
+from videop2p_tpu.parallel.ring import (
+    make_ring_temporal_fn,
+    ring_attention,
+    ring_attention_sharded,
+)
 
 __all__ = [
     "AXIS_DATA",
@@ -23,6 +27,7 @@ __all__ = [
     "replicated",
     "shard_array",
     "text_sharding",
+    "make_ring_temporal_fn",
     "ring_attention",
     "ring_attention_sharded",
 ]

@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-__all__ = ["ring_attention", "ring_attention_sharded"]
+__all__ = ["ring_attention", "ring_attention_sharded", "make_ring_temporal_fn"]
 
 
 def ring_attention(
@@ -94,3 +94,17 @@ def ring_attention_sharded(
     return jax.shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
     )(q, k, v)
+
+
+def make_ring_temporal_fn(mesh: Mesh, *, axis_name: str = "frames"):
+    """Temporal-attention kernel for the UNet's ``temporal_attention_fn`` seam
+    (models/attention.py): (q, k, v) of shape (B·N, H, F, D) with the frame
+    axis sharded over ``axis_name`` → ring attention instead of the all-gather
+    GSPMD would otherwise insert for the dense f×f site. Uncontrolled passes
+    only (training / inversion / plain sampling); controlled sites materialize
+    probabilities and stay dense."""
+
+    def fn(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+        return ring_attention_sharded(q, k, v, mesh, axis_name=axis_name, seq_axis=-2)
+
+    return fn

@@ -90,8 +90,14 @@ def edit_sample(
     uncond (the reference's ``text_embeddings[0] = uncond_embeddings_pre[i]``,
     pipeline_tuneavideo.py:399-403).
     ``source_uses_cfg=False`` is the --fast mode source branch.
+
+    Per-frame ("multi") conditioning (pipeline_tuneavideo.py:366-367,399-402):
+    pass ``cond_embeddings`` as (P, F, L, D); ``uncond_embeddings`` stays
+    (L, D) and broadcasts per frame, and ``null_uncond_embeddings`` may be
+    per-frame (num_steps, F, L, D).
     """
     P = cond_embeddings.shape[0]
+    multi = cond_embeddings.ndim == 4
     # latents stay float32 in the scan carry; the UNet casts to its own
     # compute dtype internally (scheduler math is fp32 for step fidelity)
     latents = latents.astype(jnp.float32)
@@ -101,7 +107,12 @@ def edit_sample(
         raise ValueError(f"latents batch {latents.shape[0]} != num prompts {P}")
     video_length = latents.shape[1]
     latent_hw = latents.shape[2:4]
-    text_len = cond_embeddings.shape[1]
+    text_len = cond_embeddings.shape[-2]
+    if multi and cond_embeddings.shape[1] != video_length:
+        raise ValueError(
+            f"per-frame cond_embeddings {cond_embeddings.shape} do not match "
+            f"video_length {video_length}"
+        )
 
     timesteps = jnp.asarray(scheduler.timesteps(num_inference_steps))
     if uncond_embeddings.ndim == 3 and uncond_embeddings.shape[0] == 1:
@@ -112,22 +123,35 @@ def edit_sample(
             f"{uncond_embeddings.shape}; per-step null-text embeddings go in "
             "null_uncond_embeddings"
         )
+    if multi:
+        # per-frame conditioning: every stream's uncond broadcasts per frame
+        # (the reference repeats embeddings '(b f) n c', :366-367)
+        uncond_embeddings = jnp.broadcast_to(
+            uncond_embeddings[None], (video_length,) + uncond_embeddings.shape
+        )
     # the source stream's per-step uncond: the null-text sequence when given,
     # else the raw uncond every step
     if null_uncond_embeddings is not None:
-        if null_uncond_embeddings.ndim == 4:
-            if null_uncond_embeddings.shape[1] != 1:
-                raise ValueError(
-                    "null-text embeddings must be optimized on the batch-1 "
-                    f"source stream, got shape {null_uncond_embeddings.shape}"
-                )
+        if null_uncond_embeddings.ndim == 4 and null_uncond_embeddings.shape[1] == 1:
+            # (steps, 1, L, D) — the batch-1 source-stream optimization output
             null_uncond_embeddings = null_uncond_embeddings[:, 0]
-        if (
-            null_uncond_embeddings.ndim != 3
-            or null_uncond_embeddings.shape[0] != num_inference_steps
-        ):
+        if not multi and null_uncond_embeddings.ndim == 4:
             raise ValueError(
-                f"null-text embeddings must have leading dim {num_inference_steps}, "
+                "null-text embeddings must be optimized on the batch-1 "
+                f"source stream, got shape {null_uncond_embeddings.shape}"
+            )
+        if multi and null_uncond_embeddings.ndim == 3:
+            # one (L, D) per step → broadcast over frames (the reference's
+            # multi injection fills all F slots, :399-402)
+            null_uncond_embeddings = jnp.broadcast_to(
+                null_uncond_embeddings[:, None],
+                (null_uncond_embeddings.shape[0], video_length)
+                + null_uncond_embeddings.shape[1:],
+            )
+        expected = (num_inference_steps,) + uncond_embeddings.shape
+        if null_uncond_embeddings.shape != expected:
+            raise ValueError(
+                f"null-text embeddings must have shape {expected}, "
                 f"got {null_uncond_embeddings.shape}"
             )
         uncond0_seq = null_uncond_embeddings
@@ -140,21 +164,34 @@ def edit_sample(
         key = jax.random.key(0)
     use_blend = ctx is not None and ctx.blend is not None
 
+    # fast mode (source_uses_cfg=False) discards the source stream's uncond
+    # prediction (the reference computes then overwrites it,
+    # pipeline_tuneavideo.py:412-415) — skip that forward entirely: the CFG
+    # batch shrinks from 2P to (P−1)+P streams, a ~25 % FLOP cut at P=2.
+    U = P if source_uses_cfg else P - 1
+
     def step_text(uncond0):
         # stream 0's uncond is per-step (null-text seam); edit streams keep
-        # the raw uncond (pipeline_tuneavideo.py:399-403)
+        # the raw uncond (pipeline_tuneavideo.py:399-403). In fast mode the
+        # source uncond stream does not exist (its output was unused).
         u = jnp.broadcast_to(uncond_embeddings[None], (P,) + uncond_embeddings.shape)
-        u = jnp.concatenate([uncond0[None], u[1:]], axis=0)
+        if source_uses_cfg:
+            u = jnp.concatenate([uncond0[None], u[1:]], axis=0)
+        else:
+            u = u[1:]
         return jnp.concatenate([u, cond_embeddings], axis=0)
+
+    def step_latents(latents):
+        return jnp.concatenate([latents[P - U:], latents], axis=0)
 
     maps_sum = None
     if use_blend:
         # fixed carry shape: count blend sites from an abstract forward
-        control0 = AttnControl(ctx=ctx, step_index=jnp.asarray(0))
+        control0 = AttnControl(ctx=ctx, step_index=jnp.asarray(0), num_uncond=U)
         _, store_shape = jax.eval_shape(
             unet_fn,
             params,
-            jnp.concatenate([latents, latents], axis=0),
+            step_latents(latents),
             timesteps[0],
             step_text(uncond0_seq[0]),
             control0,
@@ -167,6 +204,7 @@ def edit_sample(
                 num_prompts=P,
                 text_len=text_len,
                 blend_res=blend_res,
+                num_uncond=U,
             ),
             store_shape,
         )
@@ -175,14 +213,20 @@ def edit_sample(
     def body(carry, xs):
         latents, maps_sum, key = carry
         t, i, uncond = xs
-        latent_in = jnp.concatenate([latents, latents], axis=0)
+        latent_in = step_latents(latents)
         text = step_text(uncond)
-        control = AttnControl(ctx=ctx, step_index=i) if ctx is not None else None
+        control = (
+            AttnControl(ctx=ctx, step_index=i, num_uncond=U) if ctx is not None else None
+        )
         eps_all, store = unet_fn(params, latent_in, t, text, control)
-        eps_uncond, eps_text = eps_all[:P], eps_all[P:]
-        eps = eps_uncond + guidance_scale * (eps_text - eps_uncond)
-        if not source_uses_cfg:
-            eps = eps.at[0].set(eps_text[0])
+        eps_uncond, eps_text = eps_all[:U], eps_all[U:]
+        if source_uses_cfg:
+            eps = eps_uncond + guidance_scale * (eps_text - eps_uncond)
+        else:
+            # edit streams get CFG against their own uncond; the source
+            # stream replays its cond-only prediction exactly
+            eps_edit = eps_uncond + guidance_scale * (eps_text[1:] - eps_uncond)
+            eps = jnp.concatenate([eps_text[:1], eps_edit], axis=0)
 
         key, sub = jax.random.split(key)
         variance_noise = None
@@ -204,8 +248,16 @@ def edit_sample(
                 num_prompts=P,
                 text_len=text_len,
                 blend_res=blend_res,
+                num_uncond=U,
             )
             latents = local_blend(latents, maps_sum, ctx.blend, i)
+        if ctx is not None and ctx.spatial_replace_until > 0:
+            # SpatialReplace step callback (run_videop2p.py:237-241): inject
+            # the source latents into every edit stream while active
+            active = i < ctx.spatial_replace_until
+            latents = jnp.where(
+                active, jnp.broadcast_to(latents[:1], latents.shape), latents
+            )
         return (latents, maps_sum, key), None
 
     xs = (timesteps, jnp.arange(num_inference_steps), uncond0_seq)

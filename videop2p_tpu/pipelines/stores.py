@@ -47,23 +47,27 @@ def blend_maps_from_store(
     num_prompts: int,
     text_len: int,
     blend_res: Tuple[int, int] | None = None,
+    num_uncond: int = -1,
 ) -> jax.Array:
     """Stack the blend-site cross maps into (P, F, S, r, r, L).
 
     Blend sites are the cross-attention layers at (latent/4)² queries — the
     16×16 maps for a 64² latent, generalizing the reference's hard-coded
     ``reshape(2, -1, 8, 16, 16, 77)`` (run_videop2p.py:146) to any latent size
-    and frame count. Only the conditional (CFG) half is kept, matching the
-    store's conditional-half rule (run_videop2p.py:217-218).
+    and frame count. Only the conditional streams are kept, matching the
+    store's conditional-half rule (run_videop2p.py:217-218); ``num_uncond``
+    counts the uncond streams ahead of them (-1 → ``num_prompts``, the
+    symmetric CFG layout; fast mode runs with ``num_prompts − 1``).
     """
     r = blend_res if blend_res is not None else (latent_hw[0] // 4, latent_hw[1] // 4)
+    U = num_prompts if num_uncond < 0 else num_uncond
     leaves = _select_blend_leaves(store, r, text_len)
     if not leaves:
         raise ValueError(
             f"no cross-attention maps at blend resolution {r} in store "
             f"(text_len={text_len}) — latent_hw mismatch?"
         )
-    stacked = jnp.stack(leaves, axis=1)  # (2·P·F, S, Q, L)
-    b2pf, s, q, L = stacked.shape
-    stacked = stacked.reshape(2, num_prompts, video_length, s, r[0], r[1], L)
-    return stacked[1]  # conditional half → (P, F, S, r, r, L)
+    stacked = jnp.stack(leaves, axis=1)  # ((U+P)·F, S, Q, L)
+    _, s, q, L = stacked.shape
+    stacked = stacked.reshape(U + num_prompts, video_length, s, r[0], r[1], L)
+    return stacked[U:]  # conditional streams → (P, F, S, r, r, L)

@@ -68,22 +68,28 @@ class InferencePipeline:
         from videop2p_tpu.utils.video_io import save_video_gif
 
         bundle = self._bundle
-        key = jax.random.key(seed)
+        key, noise_key, edit_key = jax.random.split(jax.random.key(seed), 3)
+        expected_shape = (1, video_length, height // 8, width // 8, 4)
         x_t = None
         if use_inv_latent:
             inv = self._latest_inv_latent()
             if inv is not None:
-                x_t = jnp.asarray(inv)
+                if tuple(inv.shape) == expected_shape:
+                    x_t = jnp.asarray(inv)
+                else:
+                    print(
+                        f"[inference] stored inversion latent {inv.shape} does not "
+                        f"match the requested video {expected_shape} — sampling "
+                        "from fresh noise instead"
+                    )
         if x_t is None:
-            x_t = jax.random.normal(
-                key, (1, video_length, height // 8, width // 8, 4), jnp.float32
-            )
+            x_t = jax.random.normal(noise_key, expected_shape, jnp.float32)
         cond = encode_prompts(bundle, [prompt])
         uncond = encode_prompts(bundle, [""])[0]
         unet_fn = make_unet_fn(bundle.unet)
         out = edit_sample(
-            unet_fn, bundle.unet_params, DDIMScheduler.create_sd(), x_t, cond, uncond,
-            num_inference_steps=num_steps, guidance_scale=guidance_scale, key=key,
+            unet_fn, bundle.unet_params, bundle.make_scheduler(), x_t, cond, uncond,
+            num_inference_steps=num_steps, guidance_scale=guidance_scale, key=edit_key,
         )
         frames = decode_video(bundle.vae, bundle.vae_params, out.astype(jnp.bfloat16))
         video = np.asarray(jax.device_get((frames.astype(jnp.float32) + 1) / 2))[0]

@@ -42,11 +42,13 @@ def save_video_gif(video: np.ndarray, path: str, *, fps: int = 4) -> str:
     """Write one (F, H, W, C) video in [0, 1] as a looping GIF — the Stage-2
     per-stream artifact (run_videop2p.py:698-701 writes each stream with
     duration=250 ms, i.e. 4 fps)."""
-    import imageio
+    import imageio.v3 as iio
 
-    frames = list(to_uint8(video))
+    frames = to_uint8(video)
     os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
-    imageio.mimsave(path, frames, duration=1000.0 / fps, loop=0)
+    # v3 pillow plugin: duration is unambiguously milliseconds (the legacy
+    # mimsave GIF writer documented seconds on older imageio versions)
+    iio.imwrite(path, frames, extension=".gif", duration=int(1000 / fps), loop=0)
     return path
 
 
@@ -73,5 +75,9 @@ def save_videos_grid(
             return path
         except Exception:
             path = path[:-4] + ".gif"
-    imageio.mimsave(path, frames, duration=1000.0 / fps, loop=0)
+    import imageio.v3 as iio
+
+    iio.imwrite(
+        path, np.stack(frames), extension=".gif", duration=int(1000 / fps), loop=0
+    )
     return path
