@@ -200,3 +200,85 @@ def test_pipeline_dir_roundtrip(tmp_path, tiny_unet_params):
     out_arr = loaded.unet.apply(loaded.unet_params, sample, jnp.asarray(3), text)
     ref_arr = model.apply({"params": params}, sample, jnp.asarray(3), text)
     np.testing.assert_allclose(np.asarray(out_arr), np.asarray(ref_arr), atol=1e-5)
+
+
+def test_unet3d_matches_torch_reference():
+    """Golden numerical parity: a hand-built torch mirror of the reference
+    UNet3D (tests/torch_ref.py, semantics from
+    /root/reference/tuneavideo/models/*) produces a diffusers-layout state
+    dict; importing it through convert.unet3d_params_from_torch must make the
+    flax forward equal the torch forward. This backs the converter beyond
+    round-trip consistency (a consistent-but-wrong mapping would fail here)."""
+    import torch
+
+    from tests.torch_ref import TorchUNet3D
+
+    cfg = UNet3DConfig.tiny()
+    torch.manual_seed(0)
+    tmodel = TorchUNet3D(cfg).eval()
+    sd = {k: v.detach().numpy() for k, v in tmodel.state_dict().items()}
+
+    model = UNet3DConditionModel(config=cfg)
+    B, F, S = 1, 2, 8
+    x = np.random.RandomState(0).randn(B, F, S, S, cfg.in_channels).astype(np.float32)
+    ctx = np.random.RandomState(1).randn(B, 7, cfg.cross_attention_dim).astype(np.float32)
+    t = np.array([317], dtype=np.int32)
+
+    abstract = jax.eval_shape(
+        lambda: model.init(jax.random.key(0), jnp.asarray(x), jnp.asarray(t), jnp.asarray(ctx))
+    )["params"]
+    params, report = unet3d_params_from_torch(sd, abstract)
+    assert report["kept_init"] == [], report["kept_init"]
+    assert report["unused"] == [], report["unused"]
+
+    out_flax = model.apply({"params": params}, jnp.asarray(x), jnp.asarray(t), jnp.asarray(ctx))
+    with torch.no_grad():
+        out_torch = tmodel(
+            torch.tensor(np.transpose(x, (0, 4, 1, 2, 3))),
+            torch.tensor(t),
+            torch.tensor(ctx),
+        )
+    out_torch = np.transpose(out_torch.numpy(), (0, 2, 3, 4, 1))
+    np.testing.assert_allclose(np.asarray(out_flax), out_torch, atol=5e-5)
+
+
+def test_vae_matches_torch_reference():
+    """Golden numerical parity for the VAE importer: encode moments and the
+    decode image from the hand-built torch AutoencoderKL (tests/torch_ref.py)
+    must match the flax model after vae_params_from_torch."""
+    import torch
+
+    from tests.torch_ref import TorchVAE
+
+    cfg = VAEConfig.tiny()
+    torch.manual_seed(1)
+    tvae = TorchVAE(cfg).eval()
+    sd = {k: v.detach().numpy() for k, v in tvae.state_dict().items()}
+
+    model = AutoencoderKL(config=cfg)
+    x = np.random.RandomState(2).randn(2, 16, 16, cfg.in_channels).astype(np.float32)
+    variables = jax.eval_shape(
+        lambda: model.init(jax.random.key(0), jnp.asarray(x), jax.random.key(1))
+    )
+    params = vae_params_from_torch(sd, variables["params"])
+
+    mean, logvar = model.apply(
+        {"params": params}, jnp.asarray(x), method=model.encode
+    )
+    with torch.no_grad():
+        moments = tvae.encode_moments(torch.tensor(np.transpose(x, (0, 3, 1, 2))))
+        t_mean, t_logvar = moments.chunk(2, dim=1)
+        z = t_mean  # decode the mean latent
+        t_img = tvae.decode(z)
+    np.testing.assert_allclose(
+        np.asarray(mean), np.transpose(t_mean.numpy(), (0, 2, 3, 1)), atol=5e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(logvar),
+        np.clip(np.transpose(t_logvar.numpy(), (0, 2, 3, 1)), -30, 20),
+        atol=5e-5,
+    )
+    img = model.apply({"params": params}, mean, method=model.decode)
+    np.testing.assert_allclose(
+        np.asarray(img), np.transpose(t_img.numpy(), (0, 2, 3, 1)), atol=5e-5
+    )

@@ -81,3 +81,32 @@ def test_max_pool_window():
     pooled = np.asarray(_max_pool_3x3(x))
     assert pooled[0, 0, 1:4, 1:4].min() == 1.0
     assert pooled[0, 0, 0, 0] == 0.0
+
+
+def test_blend_maps_fallback_to_nearest_site():
+    """When no cross site sits at the (latent/4)² default, the nearest square
+    site is used (tiny UNets at small latents; cli smoke path)."""
+    import jax.numpy as jnp
+
+    from videop2p_tpu.pipelines.stores import blend_maps_from_store
+
+    P, F, L = 2, 2, 77
+    # store with sites at 8²=64 and 4²=16 queries only (tiny UNet at 8×8)
+    store = {
+        "down": {"attn2": {"maps": jnp.ones((2 * P * F, 64, L))}},
+        "up": {"attn2": {"maps": jnp.ones((2 * P * F, 16, L))}},
+    }
+    out = blend_maps_from_store(
+        store, latent_hw=(8, 8), video_length=F, num_prompts=P, text_len=L,
+    )
+    # default rule wants 2×2=4 queries; nearest available square is 16 → 4×4
+    assert out.shape == (P, F, 1, 4, 4, L)
+
+    # explicit blend_res still errors when absent
+    import pytest
+
+    with pytest.raises(ValueError, match="no cross-attention maps"):
+        blend_maps_from_store(
+            store, latent_hw=(8, 8), video_length=F, num_prompts=P, text_len=L,
+            blend_res=(3, 3),
+        )

@@ -312,3 +312,24 @@ def test_multi_frame_embeddings_match_shared(sched, tiny):
         )
     )(x_t)
     assert not np.allclose(np.asarray(out4v), np.asarray(out3), atol=1e-4)
+
+
+def test_null_text_chunked_matches_full(sched):
+    """outer_chunk splits the outer scan into host-level jitted chunks — the
+    result must be identical to the single-scan path (watchdog workaround
+    for the multi-minute SD-scale program)."""
+    fn = text_unet()
+    x0 = jax.random.normal(jax.random.key(0), SHAPE)
+    cond = 0.3 * jnp.ones((1, 77, 8))
+    uncond = jnp.zeros((1, 77, 8))
+    traj = ddim_inversion(fn, None, sched, x0, cond, num_inference_steps=STEPS)
+    full = null_text_optimization(
+        fn, None, sched, traj, cond, uncond, num_inference_steps=STEPS,
+    )
+    chunked = null_text_optimization(
+        fn, None, sched, traj, cond, uncond, num_inference_steps=STEPS,
+        outer_chunk=4,  # 10 steps → chunks of 4, 4, 2 (uneven tail covered)
+    )
+    np.testing.assert_allclose(
+        np.asarray(chunked), np.asarray(full), rtol=2e-5, atol=2e-6
+    )

@@ -166,3 +166,62 @@ def test_unknown_block_type_raises(tiny_unet):
     )
     with pytest.raises(ValueError, match="unknown down block type"):
         bad.init(jax.random.key(0), sample, jnp.asarray(1), text)
+
+
+def test_sdxl_preset_shape_and_depths():
+    """SDXL-shaped stress config (BASELINE config 4): 3 levels, per-block
+    transformer depths (1, 2, 10), 2048-dim context, 64-wide heads."""
+    cfg = UNet3DConfig.sdxl()
+    assert cfg.block_out_channels == (320, 640, 1280)
+    assert cfg.transformer_depth == (1, 2, 10)
+    assert cfg.attention_head_dim == (5, 10, 20)
+    assert cfg.cross_attention_dim == 2048
+    assert cfg.down_block_types[0] == "DownBlock3D"  # no attention at level 0
+    assert cfg.up_block_types[-1] == "UpBlock3D"
+
+
+def test_sdxl_shaped_forward_and_torch_parity():
+    """Width-scaled SDXL topology (same per-block depth/head structure) must
+    run, and the converter must map the per-block transformer depths — the
+    deep upper blocks have transformer_blocks.0..N keys per site."""
+    import torch
+
+    from tests.torch_ref import TorchUNet3D
+    from videop2p_tpu.models.convert import unet3d_params_from_torch
+
+    cfg = UNet3DConfig.sdxl(
+        sample_size=8,
+        block_out_channels=(8, 16, 32),
+        attention_head_dim=(1, 2, 4),
+        transformer_depth=(1, 2, 3),
+        cross_attention_dim=16,
+        norm_num_groups=4,
+        layers_per_block=1,
+    )
+    torch.manual_seed(3)
+    tmodel = TorchUNet3D(cfg).eval()
+    sd = {k: v.detach().numpy() for k, v in tmodel.state_dict().items()}
+    # deep block: level-2 down attention carries 3 transformer blocks
+    assert any("down_blocks.2.attentions.0.transformer_blocks.2." in k for k in sd)
+
+    model = UNet3DConditionModel(config=cfg)
+    B, F, S = 1, 2, 8
+    x = np.random.RandomState(0).randn(B, F, S, S, cfg.in_channels).astype(np.float32)
+    ctx = np.random.RandomState(1).randn(B, 7, cfg.cross_attention_dim).astype(np.float32)
+    t = np.array([11], dtype=np.int32)
+    abstract = jax.eval_shape(
+        lambda: model.init(jax.random.key(0), jnp.asarray(x), jnp.asarray(t), jnp.asarray(ctx))
+    )["params"]
+    params, report = unet3d_params_from_torch(sd, abstract)
+    assert report["kept_init"] == [] and report["unused"] == []
+    out_flax = model.apply({"params": params}, jnp.asarray(x), jnp.asarray(t), jnp.asarray(ctx))
+    with torch.no_grad():
+        out_torch = tmodel(
+            torch.tensor(np.transpose(x, (0, 4, 1, 2, 3))),
+            torch.tensor(t), torch.tensor(ctx),
+        )
+    np.testing.assert_allclose(
+        np.asarray(out_flax),
+        np.transpose(out_torch.numpy(), (0, 2, 3, 4, 1)),
+        atol=5e-5,
+    )

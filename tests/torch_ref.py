@@ -1,0 +1,550 @@
+"""Hand-built torch mirror of the reference video UNet for parity tests.
+
+diffusers is not installed in this image, so these modules re-implement the
+reference's blocks (/root/reference/tuneavideo/models/{unet,unet_blocks,
+attention,resnet}.py) directly in torch with diffusers-compatible parameter
+names — ``state_dict()`` of :class:`TorchUNet3D` is a valid input to
+``videop2p_tpu.models.convert.unet3d_params_from_torch``. Layout is the
+reference's channels-first ``(B, C, F, H, W)``.
+
+Only what the tiny test config exercises is implemented; semantics follow the
+reference line-by-line (frame-0 KV frame attention attention.py:296-302,
+temporal rearrange :262-268, GEGLU FF, time-emb broadcast resnet.py:181-184,
+skip-concat up path unet_blocks.py:486-488).
+"""
+
+from __future__ import annotations
+
+import math
+
+import torch
+import torch.nn.functional as F
+from torch import nn
+
+
+class InflatedConv3d(nn.Conv2d):
+    """2-D conv applied per frame (resnet.py:11-19)."""
+
+    def forward(self, x):  # (B, C, F, H, W)
+        b, c, f, h, w = x.shape
+        x = x.permute(0, 2, 1, 3, 4).reshape(b * f, c, h, w)
+        x = super().forward(x)
+        return x.reshape(b, f, *x.shape[1:]).permute(0, 2, 1, 3, 4)
+
+
+def timestep_embedding(timesteps, dim, *, flip_sin_to_cos=True, shift=0.0):
+    """diffusers ``Timesteps`` (unet.py:120-124 config)."""
+    half = dim // 2
+    exponent = -math.log(10000.0) * torch.arange(half, dtype=torch.float32)
+    exponent = exponent / (half - shift)
+    emb = timesteps.float()[:, None] * torch.exp(exponent)[None, :]
+    sin, cos = torch.sin(emb), torch.cos(emb)
+    return torch.cat([cos, sin] if flip_sin_to_cos else [sin, cos], dim=-1)
+
+
+class TimestepEmbedding(nn.Module):
+    def __init__(self, in_dim, dim):
+        super().__init__()
+        self.linear_1 = nn.Linear(in_dim, dim)
+        self.linear_2 = nn.Linear(dim, dim)
+
+    def forward(self, x):
+        return self.linear_2(F.silu(self.linear_1(x)))
+
+
+class ResnetBlock3D(nn.Module):
+    """resnet.py:111-205 (``time_embedding_norm="default"``, swish)."""
+
+    def __init__(self, in_ch, out_ch, temb_ch, groups):
+        super().__init__()
+        self.norm1 = nn.GroupNorm(groups, in_ch, eps=1e-5)
+        self.conv1 = InflatedConv3d(in_ch, out_ch, 3, padding=1)
+        self.time_emb_proj = nn.Linear(temb_ch, out_ch)
+        self.norm2 = nn.GroupNorm(groups, out_ch, eps=1e-5)
+        self.conv2 = InflatedConv3d(out_ch, out_ch, 3, padding=1)
+        self.conv_shortcut = (
+            InflatedConv3d(in_ch, out_ch, 1) if in_ch != out_ch else None
+        )
+
+    def forward(self, x, temb):
+        h = self.conv1(F.silu(self.norm1(x)))
+        h = h + self.time_emb_proj(F.silu(temb))[:, :, None, None, None]
+        h = self.conv2(F.silu(self.norm2(h)))
+        if self.conv_shortcut is not None:
+            x = self.conv_shortcut(x)
+        return x + h
+
+
+class _Attention(nn.Module):
+    """The reference's ``CrossAttention`` shape (diffusers 0.11): to_q/k/v
+    bias-free, out proj in a ModuleList (→ ``to_out.0``)."""
+
+    def __init__(self, dim, ctx_dim, heads):
+        super().__init__()
+        self.heads = heads
+        self.to_q = nn.Linear(dim, dim, bias=False)
+        self.to_k = nn.Linear(ctx_dim, dim, bias=False)
+        self.to_v = nn.Linear(ctx_dim, dim, bias=False)
+        self.to_out = nn.ModuleList([nn.Linear(dim, dim)])
+
+    def attend(self, q, k, v):
+        b, n, c = q.shape
+        h = self.heads
+        d = c // h
+        q = q.reshape(b, n, h, d).transpose(1, 2)
+        k = k.reshape(b, k.shape[1], h, d).transpose(1, 2)
+        v = v.reshape(b, v.shape[1], h, d).transpose(1, 2)
+        sim = torch.einsum("bhqd,bhkd->bhqk", q, k) * d**-0.5
+        probs = sim.float().softmax(dim=-1).to(q.dtype)
+        out = torch.einsum("bhqk,bhkd->bhqd", probs, v)
+        return out.transpose(1, 2).reshape(b, n, c)
+
+    def forward(self, x, context=None):
+        ctx = x if context is None else context
+        return self.to_out[0](self.attend(self.to_q(x), self.to_k(ctx), self.to_v(ctx)))
+
+
+class FrameAttention(_Attention):
+    """Spatial self-attention with frame-0 keys/values (attention.py:239-328).
+    Input (B·F, N, C) with ``video_length`` frames folded batch-major."""
+
+    def forward(self, x, video_length):
+        bf, n, c = x.shape
+        b = bf // video_length
+        kv = x.reshape(b, video_length, n, c)[:, [0] * video_length].reshape(bf, n, c)
+        return self.to_out[0](self.attend(self.to_q(x), self.to_k(kv), self.to_v(kv)))
+
+
+class GEGLUFeedForward(nn.Module):
+    """diffusers ``FeedForward`` with GEGLU (→ ``ff.net.0.proj`` / ``ff.net.2``)."""
+
+    def __init__(self, dim, mult=4):
+        super().__init__()
+        proj = nn.Linear(dim, dim * mult * 2)
+        self.net = nn.ModuleList([nn.ModuleDict({"proj": proj}), nn.Identity(),
+                                  nn.Linear(dim * mult, dim)])
+
+    def forward(self, x):
+        h, gate = self.net[0]["proj"](x).chunk(2, dim=-1)
+        return self.net[2](h * F.gelu(gate))
+
+
+class BasicTransformerBlock(nn.Module):
+    """attention.py:140-268: frame-attn → cross-attn → FF → temporal attn."""
+
+    def __init__(self, dim, ctx_dim, heads):
+        super().__init__()
+        self.norm1 = nn.LayerNorm(dim)
+        self.attn1 = FrameAttention(dim, dim, heads)
+        self.norm2 = nn.LayerNorm(dim)
+        self.attn2 = _Attention(dim, ctx_dim, heads)
+        self.norm3 = nn.LayerNorm(dim)
+        self.ff = GEGLUFeedForward(dim)
+        self.norm_temp = nn.LayerNorm(dim)
+        self.attn_temp = _Attention(dim, dim, heads)
+
+    def forward(self, x, context, video_length):  # x: (B·F, N, C)
+        x = x + self.attn1(self.norm1(x), video_length)
+        x = x + self.attn2(self.norm2(x), context)
+        x = x + self.ff(self.norm3(x))
+        # temporal: (B·F, N, C) → (B·N, F, C)  (attention.py:262-268)
+        bf, n, c = x.shape
+        b = bf // video_length
+        h = x.reshape(b, video_length, n, c).permute(0, 2, 1, 3).reshape(b * n, video_length, c)
+        h = self.attn_temp(self.norm_temp(h))
+        h = h.reshape(b, n, video_length, c).permute(0, 2, 1, 3).reshape(bf, n, c)
+        return x + h
+
+
+class Transformer3DModel(nn.Module):
+    """attention.py:32-137: GN → 1×1-conv proj_in → blocks → proj_out + res."""
+
+    def __init__(self, channels, ctx_dim, heads, depth, groups):
+        super().__init__()
+        self.norm = nn.GroupNorm(groups, channels, eps=1e-6)
+        self.proj_in = nn.Conv2d(channels, channels, 1)
+        self.transformer_blocks = nn.ModuleList(
+            [BasicTransformerBlock(channels, ctx_dim, heads) for _ in range(depth)]
+        )
+        self.proj_out = nn.Conv2d(channels, channels, 1)
+
+    def forward(self, x, context):  # (B, C, F, H, W), context (B, L, D)
+        b, c, f, hh, ww = x.shape
+        residual = x
+        h = x.permute(0, 2, 1, 3, 4).reshape(b * f, c, hh, ww)  # fold frames
+        h = self.proj_in(self.norm(h))
+        h = h.permute(0, 2, 3, 1).reshape(b * f, hh * ww, c)
+        ctx = context.repeat_interleave(f, dim=0)  # text per frame (:94-95)
+        for blk in self.transformer_blocks:
+            h = blk(h, ctx, f)
+        h = h.reshape(b * f, hh, ww, c).permute(0, 3, 1, 2)
+        h = self.proj_out(h)
+        h = h.reshape(b, f, c, hh, ww).permute(0, 2, 1, 3, 4)
+        return h + residual
+
+
+class Downsample3D(nn.Module):
+    def __init__(self, ch):
+        super().__init__()
+        self.conv = InflatedConv3d(ch, ch, 3, stride=2, padding=1)
+
+    def forward(self, x):
+        return self.conv(x)
+
+
+class Upsample3D(nn.Module):
+    def __init__(self, ch):
+        super().__init__()
+        self.conv = InflatedConv3d(ch, ch, 3, padding=1)
+
+    def forward(self, x):  # nearest ×2 spatial (resnet.py:22-74)
+        b, c, f, h, w = x.shape
+        x = x.reshape(b, c * f, h, w)
+        x = F.interpolate(x, scale_factor=2.0, mode="nearest")
+        x = x.reshape(b, c, f, h * 2, w * 2)
+        return self.conv(x)
+
+
+class CrossAttnDownBlock3D(nn.Module):
+    def __init__(self, in_ch, out_ch, temb_ch, ctx_dim, heads, depth, groups,
+                 num_layers, add_downsample):
+        super().__init__()
+        self.resnets = nn.ModuleList(
+            [ResnetBlock3D(in_ch if i == 0 else out_ch, out_ch, temb_ch, groups)
+             for i in range(num_layers)]
+        )
+        self.attentions = nn.ModuleList(
+            [Transformer3DModel(out_ch, ctx_dim, heads, depth, groups)
+             for _ in range(num_layers)]
+        )
+        self.downsamplers = (
+            nn.ModuleList([Downsample3D(out_ch)]) if add_downsample else None
+        )
+
+    def forward(self, x, temb, ctx):
+        outs = []
+        for res, attn in zip(self.resnets, self.attentions):
+            x = attn(res(x, temb), ctx)
+            outs.append(x)
+        if self.downsamplers is not None:
+            x = self.downsamplers[0](x)
+            outs.append(x)
+        return x, outs
+
+
+class DownBlock3D(nn.Module):
+    def __init__(self, in_ch, out_ch, temb_ch, groups, num_layers, add_downsample):
+        super().__init__()
+        self.resnets = nn.ModuleList(
+            [ResnetBlock3D(in_ch if i == 0 else out_ch, out_ch, temb_ch, groups)
+             for i in range(num_layers)]
+        )
+        self.downsamplers = (
+            nn.ModuleList([Downsample3D(out_ch)]) if add_downsample else None
+        )
+
+    def forward(self, x, temb):
+        outs = []
+        for res in self.resnets:
+            x = res(x, temb)
+            outs.append(x)
+        if self.downsamplers is not None:
+            x = self.downsamplers[0](x)
+            outs.append(x)
+        return x, outs
+
+
+class UNetMidBlock3DCrossAttn(nn.Module):
+    def __init__(self, ch, temb_ch, ctx_dim, heads, depth, groups, num_layers=1):
+        super().__init__()
+        self.resnets = nn.ModuleList(
+            [ResnetBlock3D(ch, ch, temb_ch, groups) for _ in range(num_layers + 1)]
+        )
+        self.attentions = nn.ModuleList(
+            [Transformer3DModel(ch, ctx_dim, heads, depth, groups)
+             for _ in range(num_layers)]
+        )
+
+    def forward(self, x, temb, ctx):
+        x = self.resnets[0](x, temb)
+        for attn, res in zip(self.attentions, self.resnets[1:]):
+            x = res(attn(x, ctx), temb)
+        return x
+
+
+class CrossAttnUpBlock3D(nn.Module):
+    def __init__(self, in_ch, out_ch, prev_ch, temb_ch, ctx_dim, heads, depth,
+                 groups, num_layers, add_upsample, skip_chs):
+        super().__init__()
+        self.resnets = nn.ModuleList(
+            [ResnetBlock3D(
+                (prev_ch if i == 0 else out_ch) + skip_chs[i], out_ch, temb_ch, groups)
+             for i in range(num_layers)]
+        )
+        self.attentions = nn.ModuleList(
+            [Transformer3DModel(out_ch, ctx_dim, heads, depth, groups)
+             for _ in range(num_layers)]
+        )
+        self.upsamplers = nn.ModuleList([Upsample3D(out_ch)]) if add_upsample else None
+
+    def forward(self, x, res_samples, temb, ctx):
+        res_samples = list(res_samples)
+        for res, attn in zip(self.resnets, self.attentions):
+            x = torch.cat([x, res_samples.pop()], dim=1)
+            x = attn(res(x, temb), ctx)
+        if self.upsamplers is not None:
+            x = self.upsamplers[0](x)
+        return x
+
+
+class UpBlock3D(nn.Module):
+    def __init__(self, in_ch, out_ch, prev_ch, temb_ch, groups, num_layers,
+                 add_upsample, skip_chs):
+        super().__init__()
+        self.resnets = nn.ModuleList(
+            [ResnetBlock3D(
+                (prev_ch if i == 0 else out_ch) + skip_chs[i], out_ch, temb_ch, groups)
+             for i in range(num_layers)]
+        )
+        self.upsamplers = nn.ModuleList([Upsample3D(out_ch)]) if add_upsample else None
+
+    def forward(self, x, res_samples, temb):
+        res_samples = list(res_samples)
+        for res in self.resnets:
+            x = torch.cat([x, res_samples.pop()], dim=1)
+            x = res(x, temb)
+        if self.upsamplers is not None:
+            x = self.upsamplers[0](x)
+        return x
+
+
+class TorchUNet3D(nn.Module):
+    """The reference ``UNet3DConditionModel`` (unet.py:38-415) at an arbitrary
+    config dict matching :class:`videop2p_tpu.models.UNet3DConfig` fields."""
+
+    def __init__(self, cfg):
+        super().__init__()
+        chans = cfg.block_out_channels
+        n = len(chans)
+        temb_ch = chans[0] * 4
+        groups = cfg.norm_num_groups
+        depths = cfg.transformer_depth if isinstance(cfg.transformer_depth, tuple) \
+            else (cfg.transformer_depth,) * n
+        heads = cfg.attention_head_dim if isinstance(cfg.attention_head_dim, tuple) \
+            else (cfg.attention_head_dim,) * n
+        L = cfg.layers_per_block
+        self.cfg = cfg
+        self.conv_in = InflatedConv3d(cfg.in_channels, chans[0], 3, padding=1)
+        self.time_embedding = TimestepEmbedding(chans[0], temb_ch)
+
+        self.down_blocks = nn.ModuleList()
+        skip_stack = [chans[0]]
+        in_ch = chans[0]
+        for i, bt in enumerate(cfg.down_block_types):
+            out_ch = chans[i]
+            final = i == n - 1
+            if bt == "CrossAttnDownBlock3D":
+                blk = CrossAttnDownBlock3D(
+                    in_ch, out_ch, temb_ch, cfg.cross_attention_dim, heads[i],
+                    depths[i], groups, L, not final)
+            else:
+                blk = DownBlock3D(in_ch, out_ch, temb_ch, groups, L, not final)
+            self.down_blocks.append(blk)
+            skip_stack.extend([out_ch] * L + ([out_ch] if not final else []))
+            in_ch = out_ch
+
+        self.mid_block = UNetMidBlock3DCrossAttn(
+            chans[-1], temb_ch, cfg.cross_attention_dim, heads[-1], depths[-1], groups)
+
+        self.up_blocks = nn.ModuleList()
+        rev = tuple(reversed(chans))
+        rev_heads = tuple(reversed(heads))
+        rev_depths = tuple(reversed(depths))
+        prev_ch = chans[-1]
+        for i, bt in enumerate(cfg.up_block_types):
+            out_ch = rev[i]
+            final = i == n - 1
+            num_layers = L + 1
+            skips = [skip_stack.pop() for _ in range(num_layers)]
+            if bt == "CrossAttnUpBlock3D":
+                blk = CrossAttnUpBlock3D(
+                    None, out_ch, prev_ch, temb_ch, cfg.cross_attention_dim,
+                    rev_heads[i], rev_depths[i], groups, num_layers, not final, skips)
+            else:
+                blk = UpBlock3D(None, out_ch, prev_ch, temb_ch, groups,
+                                num_layers, not final, skips)
+            self.up_blocks.append(blk)
+            prev_ch = out_ch
+
+        self.conv_norm_out = nn.GroupNorm(groups, chans[0], eps=1e-5)
+        self.conv_out = InflatedConv3d(chans[0], cfg.out_channels, 3, padding=1)
+
+    def forward(self, sample, timesteps, context):  # (B,C,F,H,W), (B,), (B,L,D)
+        temb = self.time_embedding(
+            timestep_embedding(timesteps, self.cfg.block_out_channels[0])
+        )
+        x = self.conv_in(sample)
+        res_stack = [x]
+        for blk in self.down_blocks:
+            if isinstance(blk, CrossAttnDownBlock3D):
+                x, outs = blk(x, temb, context)
+            else:
+                x, outs = blk(x, temb)
+            res_stack.extend(outs)
+        x = self.mid_block(x, temb, context)
+        for blk in self.up_blocks:
+            num_layers = len(blk.resnets)
+            res = res_stack[-num_layers:]
+            del res_stack[-num_layers:]
+            if isinstance(blk, CrossAttnUpBlock3D):
+                x = blk(x, res, temb, context)
+            else:
+                x = blk(x, res, temb)
+        return self.conv_out(F.silu(self.conv_norm_out(x)))
+
+
+# --------------------------------------------------------------------- #
+# VAE (diffusers AutoencoderKL layout, /root/reference uses it frozen)
+# --------------------------------------------------------------------- #
+
+
+class VAEResnet(nn.Module):
+    def __init__(self, in_ch, out_ch, groups):
+        super().__init__()
+        self.norm1 = nn.GroupNorm(groups, in_ch, eps=1e-6)
+        self.conv1 = nn.Conv2d(in_ch, out_ch, 3, padding=1)
+        self.norm2 = nn.GroupNorm(groups, out_ch, eps=1e-6)
+        self.conv2 = nn.Conv2d(out_ch, out_ch, 3, padding=1)
+        self.conv_shortcut = nn.Conv2d(in_ch, out_ch, 1) if in_ch != out_ch else None
+
+    def forward(self, x):
+        h = self.conv1(F.silu(self.norm1(x)))
+        h = self.conv2(F.silu(self.norm2(h)))
+        if self.conv_shortcut is not None:
+            x = self.conv_shortcut(x)
+        return x + h
+
+
+class VAEAttention(nn.Module):
+    """Single-head mid-block attention (diffusers ≥0.15 to_q/k/v naming)."""
+
+    def __init__(self, ch, groups):
+        super().__init__()
+        self.group_norm = nn.GroupNorm(groups, ch, eps=1e-6)
+        self.to_q = nn.Linear(ch, ch)
+        self.to_k = nn.Linear(ch, ch)
+        self.to_v = nn.Linear(ch, ch)
+        self.to_out = nn.ModuleList([nn.Linear(ch, ch)])
+
+    def forward(self, x):
+        b, c, h, w = x.shape
+        res = x
+        t = self.group_norm(x).reshape(b, c, h * w).transpose(1, 2)
+        q, k, v = self.to_q(t), self.to_k(t), self.to_v(t)
+        sim = torch.einsum("bqc,bkc->bqk", q, k) * c**-0.5
+        probs = sim.float().softmax(dim=-1).to(q.dtype)
+        out = self.to_out[0](torch.einsum("bqk,bkc->bqc", probs, v))
+        return res + out.transpose(1, 2).reshape(b, c, h, w)
+
+
+class _VAEDown(nn.Module):
+    def __init__(self, in_ch, out_ch, groups, layers, add_down):
+        super().__init__()
+        self.resnets = nn.ModuleList(
+            [VAEResnet(in_ch if j == 0 else out_ch, out_ch, groups) for j in range(layers)]
+        )
+        self.downsamplers = (
+            nn.ModuleList([nn.ModuleDict({"conv": nn.Conv2d(out_ch, out_ch, 3, stride=2)})])
+            if add_down else None
+        )
+
+    def forward(self, x):
+        for r in self.resnets:
+            x = r(x)
+        if self.downsamplers is not None:
+            x = F.pad(x, (0, 1, 0, 1))  # diffusers Downsample2D pad=0 path
+            x = self.downsamplers[0]["conv"](x)
+        return x
+
+
+class _VAEUp(nn.Module):
+    def __init__(self, in_ch, out_ch, groups, layers, add_up):
+        super().__init__()
+        self.resnets = nn.ModuleList(
+            [VAEResnet(in_ch if j == 0 else out_ch, out_ch, groups) for j in range(layers)]
+        )
+        self.upsamplers = (
+            nn.ModuleList([nn.ModuleDict({"conv": nn.Conv2d(out_ch, out_ch, 3, padding=1)})])
+            if add_up else None
+        )
+
+    def forward(self, x):
+        for r in self.resnets:
+            x = r(x)
+        if self.upsamplers is not None:
+            x = F.interpolate(x, scale_factor=2.0, mode="nearest")
+            x = self.upsamplers[0]["conv"](x)
+        return x
+
+
+class _VAEMid(nn.Module):
+    def __init__(self, ch, groups):
+        super().__init__()
+        self.resnets = nn.ModuleList([VAEResnet(ch, ch, groups), VAEResnet(ch, ch, groups)])
+        self.attentions = nn.ModuleList([VAEAttention(ch, groups)])
+
+    def forward(self, x):
+        return self.resnets[1](self.attentions[0](self.resnets[0](x)))
+
+
+class TorchVAE(nn.Module):
+    """diffusers ``AutoencoderKL`` at a videop2p_tpu ``VAEConfig``."""
+
+    def __init__(self, cfg):
+        super().__init__()
+        chans = cfg.block_out_channels
+        g = cfg.norm_num_groups
+        L = cfg.layers_per_block
+
+        enc = nn.Module()
+        enc.conv_in = nn.Conv2d(cfg.in_channels, chans[0], 3, padding=1)
+        enc.down_blocks = nn.ModuleList()
+        in_ch = chans[0]
+        for i, ch in enumerate(chans):
+            enc.down_blocks.append(_VAEDown(in_ch, ch, g, L, i < len(chans) - 1))
+            in_ch = ch
+        enc.mid_block = _VAEMid(chans[-1], g)
+        enc.conv_norm_out = nn.GroupNorm(g, chans[-1], eps=1e-6)
+        enc.conv_out = nn.Conv2d(chans[-1], 2 * cfg.latent_channels, 3, padding=1)
+        self.encoder = enc
+
+        dec = nn.Module()
+        rev = tuple(reversed(chans))
+        dec.conv_in = nn.Conv2d(cfg.latent_channels, rev[0], 3, padding=1)
+        dec.mid_block = _VAEMid(rev[0], g)
+        dec.up_blocks = nn.ModuleList()
+        in_ch = rev[0]
+        for i, ch in enumerate(rev):
+            dec.up_blocks.append(_VAEUp(in_ch, ch, g, L + 1, i < len(rev) - 1))
+            in_ch = ch
+        dec.conv_norm_out = nn.GroupNorm(g, rev[-1], eps=1e-6)
+        dec.conv_out = nn.Conv2d(rev[-1], cfg.out_channels, 3, padding=1)
+        self.decoder = dec
+
+        self.quant_conv = nn.Conv2d(2 * cfg.latent_channels, 2 * cfg.latent_channels, 1)
+        self.post_quant_conv = nn.Conv2d(cfg.latent_channels, cfg.latent_channels, 1)
+
+    def encode_moments(self, x):
+        h = self.encoder.conv_in(x)
+        for blk in self.encoder.down_blocks:
+            h = blk(h)
+        h = self.encoder.mid_block(h)
+        h = self.encoder.conv_out(F.silu(self.encoder.conv_norm_out(h)))
+        return self.quant_conv(h)
+
+    def decode(self, z):
+        h = self.decoder.conv_in(self.post_quant_conv(z))
+        h = self.decoder.mid_block(h)
+        for blk in self.decoder.up_blocks:
+            h = blk(h)
+        return self.decoder.conv_out(F.silu(self.decoder.conv_norm_out(h)))

@@ -108,6 +108,9 @@ class ModelBundle:
     # the checkpoint's scheduler_config.json (empty for random init) — Stage-2
     # builds its DDIM scheduler from this (run_videop2p.py:101-114)
     scheduler_config: Optional[Dict] = None
+    # cached jitted text-encoder apply (a fresh jax.jit wrapper per call would
+    # retrace every encode_prompts invocation)
+    _text_apply: Any = None
 
     def make_scheduler(self):
         from videop2p_tpu.core import DDIMScheduler
@@ -160,13 +163,45 @@ def build_models(
                 f"{len(loaded.inflation_report['kept_init'])} temporal params keep init"
             )
         tokenizer = load_tokenizer(pretrained_model_path)
+        vae, vae_params = loaded.vae, loaded.vae_params
+        text_encoder, text_params = loaded.text_encoder, loaded.text_params
+        if vae is None or text_encoder is None:
+            # a Stage-1 run that started weightless saves only the UNet — the
+            # frozen components have no tuned weights to persist. Backfill
+            # with random init so the smoke path stays drivable end-to-end.
+            warnings.warn(
+                f"checkpoint {pretrained_model_path!r} has no "
+                f"{'vae' if vae is None else ''}"
+                f"{'/' if vae is None and text_encoder is None else ''}"
+                f"{'text_encoder' if text_encoder is None else ''} — "
+                "backfilling with RANDOM-INIT components",
+                stacklevel=2,
+            )
+            ucfg = loaded.unet.config
+            small = ucfg.block_out_channels[0] < 64  # tiny-shaped checkpoint
+            key = jax.random.key(seed)
+            if vae is None:
+                vcfg = VAEConfig.tiny() if small else VAEConfig()
+                vae = AutoencoderKL(config=vcfg, dtype=dtype)
+                vae_params = dict(jax.jit(vae.init)(
+                    key, jnp.zeros((1, 64, 64, vcfg.in_channels), dtype), key
+                ))
+            if text_encoder is None:
+                ccfg = (
+                    CLIPTextConfig.tiny(hidden_size=ucfg.cross_attention_dim)
+                    if small else CLIPTextConfig()
+                )
+                text_encoder = CLIPTextEncoder(config=ccfg, dtype=dtype)
+                text_params = dict(jax.jit(text_encoder.init)(
+                    key, jnp.zeros((1, 8), jnp.int32)
+                ))
         return ModelBundle(
             unet=loaded.unet,
             unet_params=loaded.unet_params,
-            vae=loaded.vae,
-            vae_params=loaded.vae_params,
-            text_encoder=loaded.text_encoder,
-            text_params=loaded.text_params,
+            vae=vae,
+            vae_params=vae_params,
+            text_encoder=text_encoder,
+            text_params=text_params,
             tokenizer=tokenizer,
             random_init=False,
             source_dir=pretrained_model_path,
@@ -215,4 +250,6 @@ def encode_prompts(bundle: ModelBundle, prompts) -> jax.Array:
     ids = jnp.asarray(
         [bundle.tokenizer.encode_padded(p) for p in prompts], jnp.int32
     )
-    return jax.jit(bundle.text_encoder.apply)(bundle.text_params, ids)
+    if bundle._text_apply is None:
+        bundle._text_apply = jax.jit(bundle.text_encoder.apply)
+    return bundle._text_apply(bundle.text_params, ids)

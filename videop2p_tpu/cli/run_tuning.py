@@ -38,11 +38,13 @@ from videop2p_tpu.train import (
     TrainState,
     TuneConfig,
     latest_checkpoint,
+    make_lr_schedule,
     make_optimizer,
     restore_checkpoint,
     save_checkpoint,
     train_step,
 )
+from videop2p_tpu.utils.metrics import MetricsLogger
 from videop2p_tpu.utils.profiling import phase_timer
 from videop2p_tpu.utils.video_io import save_videos_grid
 
@@ -77,6 +79,10 @@ def main(
     ar_coeff: float = 0.1,
     eta: float = 0.0,
     dependent_weights: float = 0.0,
+    # device mesh "dp,sp,tp" — shards the tuning step across chips: frames
+    # over sp (ring attention at uncontrolled temporal sites), attention/FF
+    # kernels over tp. Single-clip tuning needs dp=1.
+    mesh: Optional[str] = None,
     # extras (not in the reference)
     tiny: bool = False,
     log_every: int = 50,
@@ -158,6 +164,38 @@ def main(
             first_step = int(state.step)
             print(f"[tune] resumed from {path} at step {first_step}")
 
+    if mesh:
+        from videop2p_tpu.parallel import (
+            latent_sharding,
+            make_mesh,
+            make_ring_temporal_fn,
+            param_shardings,
+        )
+
+        shape = tuple(int(t) for t in str(mesh).split(","))
+        if len(shape) != 3 or shape[0] != 1:
+            raise ValueError(
+                f"--mesh must be 1,sp,tp for single-clip tuning, got {mesh!r}"
+            )
+        device_mesh = make_mesh(shape)
+        print(f"[tune] mesh: frames={shape[1]} tensor={shape[2]}")
+        if shape[1] > 1:
+            bundle.unet = bundle.unet.clone(
+                temporal_attention_fn=make_ring_temporal_fn(device_mesh)
+            )
+        tp = shape[2] > 1
+        state = state.replace(
+            trainable=jax.device_put(
+                state.trainable,
+                param_shardings(device_mesh, state.trainable, tensor_parallel=tp),
+            ),
+            frozen=jax.device_put(
+                state.frozen,
+                param_shardings(device_mesh, state.frozen, tensor_parallel=tp),
+            ),
+        )
+        latents = jax.device_put(latents, latent_sharding(device_mesh))
+
     noise_sched = DDPMScheduler.create_sd(prediction_type=prediction_type)
     unet_fn = make_unet_fn(bundle.unet)
     step_fn = jax.jit(
@@ -167,12 +205,25 @@ def main(
         )
     )
 
+    # per-step train_loss/lr tracker (the reference's accelerator.log /
+    # TensorBoard trackers, run_tuning.py:234,337,377-378)
+    lr_schedule = make_lr_schedule(tune_cfg)
+    metrics = MetricsLogger(output_dir)
+    losses = []
     t0 = time.time()
     for i in range(first_step, max_train_steps):
         key, sk = jax.random.split(key)
         state, loss = step_fn(state, sk)
+        losses.append(loss)  # device-side; no per-step host sync
         if (i + 1) % log_every == 0 or i == first_step:
-            loss = float(jax.block_until_ready(loss))
+            # flush the buffered losses in one sync (per-step float() would
+            # serialize host dispatch against device compute)
+            start = i + 1 - len(losses)
+            for j, lv in enumerate(np.asarray(jax.block_until_ready(jnp.stack(losses)))):
+                metrics.log(start + j + 1, {"train_loss": float(lv),
+                                            "lr": float(lr_schedule(start + j))})
+            loss = float(losses[-1])
+            losses = []
             rate = (i + 1 - first_step) / max(time.time() - t0, 1e-9)
             print(f"[tune] step {i + 1}/{max_train_steps} loss={loss:.4f} "
                   f"({rate:.2f} it/s)")
@@ -184,6 +235,12 @@ def main(
                 dependent_weights=dependent_weights, sampler=sampler,
                 text_emb=text_emb, key=key,
             )
+    if losses:  # flush the tail of the buffer
+        start = max_train_steps - len(losses)
+        for j, lv in enumerate(np.asarray(jax.block_until_ready(jnp.stack(losses)))):
+            metrics.log(start + j + 1, {"train_loss": float(lv),
+                                        "lr": float(lr_schedule(start + j))})
+    metrics.close()
 
     save_pipeline(
         output_dir,
@@ -237,14 +294,18 @@ def _validate(
         else:
             x_t = jax.random.normal(key, latents.shape, latents.dtype)
 
+        # one compile shared by every validation prompt (same shapes)
+        sample_fn = jax.jit(
+            lambda p, xt, c, u: edit_sample(
+                unet_fn, p, sched, xt, c, u,
+                num_inference_steps=num_steps, guidance_scale=guidance,
+            )
+        )
+        uncond = encode_prompts(bundle, [""])[0]
         videos = []
         for prompt in prompts:
             cond = encode_prompts(bundle, [prompt])
-            uncond = encode_prompts(bundle, [""])[0]
-            out = edit_sample(
-                unet_fn, params, sched, x_t, cond, uncond,
-                num_inference_steps=num_steps, guidance_scale=guidance,
-            )
+            out = sample_fn(params, x_t, cond, uncond)
             frames = decode_video(bundle.vae, bundle.vae_params, out.astype(jnp.float32))
             videos.append(np.asarray(jax.device_get((frames + 1) / 2))[0])
     if videos:
@@ -258,10 +319,15 @@ if __name__ == "__main__":
     parser.add_argument("--config", type=str, required=True)
     parser.add_argument("--tiny", action="store_true",
                         help="random-init tiny models (weightless smoke mode)")
+    parser.add_argument("--mesh", type=str, default=None,
+                        help="device mesh 1,sp,tp (frames/tensor sharding)")
     add_dependent_args(parser)
     args = parser.parse_args()
+    cfg = load_config(args.config)
+    args.mesh = args.mesh or cfg.pop("mesh", None)
     main(
-        **load_config(args.config),
+        **cfg,
+        mesh=args.mesh,
         dependent=args.dependent,
         num_frames=args.num_frames,
         decay_rate=args.decay_rate,

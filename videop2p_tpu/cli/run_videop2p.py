@@ -145,7 +145,7 @@ def main(
                 f"dp=1 and put chips on the frame/tensor axes, got dp={dp}"
             )
         if video_len % sp:
-            raise ValueError(f"video_len {video_len} must divide the sp axis {sp}")
+            raise ValueError(f"sp axis {sp} must divide video_len {video_len}")
         device_mesh = make_mesh(shape)
         print(f"[p2p] mesh: data={dp} frames={sp} tensor={tp}")
         if sp > 1:
@@ -220,6 +220,8 @@ def main(
                 dependent_weight=dep_w,
                 dependent_sampler=sampler if dep_w > 0 else None,
                 key=nk,
+                # keep each device call short of the execution watchdog
+                outer_chunk=10,
             )
             null_embeddings = jax.block_until_ready(null_embeddings)
 

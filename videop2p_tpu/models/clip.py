@@ -95,6 +95,10 @@ class CLIPTextEncoder(nn.Module):
     def __call__(self, input_ids: jax.Array) -> jax.Array:
         cfg = self.config
         b, n = input_ids.shape
+        # wrap ids into the table: a no-op at the real 49408 vocab, and keeps
+        # tiny smoke configs (vocab 128) finite when fed real tokenizer ids —
+        # out-of-range jnp.take fills NaN outside jit
+        input_ids = input_ids % cfg.vocab_size
         tok = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=self.dtype, name="token_embedding")(
             input_ids
         )

@@ -85,6 +85,36 @@ class UNet3DConfig:
         return cls(**overrides)
 
     @classmethod
+    def sdxl(cls, **overrides) -> "UNet3DConfig":
+        """SDXL-shaped inflation stress config (BASELINE config 4; SURVEY §7
+        hard-part 6): 3 levels, deep upper transformer stacks (depth 2/10),
+        64-wide heads, 2048-dim text context, 128² latents (1024² pixels).
+        The first level carries no attention (SDXL's DownBlock2D) — its depth
+        entry is unused. SDXL's addition embeddings (text_embeds/time_ids
+        micro-conditioning) are out of scope: the stress case is the per-block
+        topology, which is config-driven here."""
+        cfg = dict(
+            sample_size=128,
+            down_block_types=(
+                "DownBlock3D",
+                "CrossAttnDownBlock3D",
+                "CrossAttnDownBlock3D",
+            ),
+            up_block_types=(
+                "CrossAttnUpBlock3D",
+                "CrossAttnUpBlock3D",
+                "UpBlock3D",
+            ),
+            block_out_channels=(320, 640, 1280),
+            layers_per_block=2,
+            transformer_depth=(1, 2, 10),
+            attention_head_dim=(5, 10, 20),  # 64-wide heads per level
+            cross_attention_dim=2048,
+        )
+        cfg.update(overrides)
+        return cls(**cfg)
+
+    @classmethod
     def tiny(cls, **overrides) -> "UNet3DConfig":
         """Miniature config for tests: two levels, 8-wide, 2 heads."""
         cfg = dict(

@@ -34,6 +34,11 @@ from videop2p_tpu.pipelines.sampling import UNetFn
 
 __all__ = ["ddim_inversion", "null_text_optimization"]
 
+# jitted chunk scans for the outer_chunk path, keyed by the statics their
+# closures bake in (runtime arrays enter as jit inputs); bounded FIFO
+_CHUNK_SCAN_CACHE: dict = {}
+_CHUNK_SCAN_CACHE_MAX = 4
+
 
 def ddim_inversion(
     unet_fn: UNetFn,
@@ -99,6 +104,7 @@ def null_text_optimization(
     dependent_weight: float = 0.0,
     dependent_sampler: Optional[DependentNoiseSampler] = None,
     key: Optional[jax.Array] = None,
+    outer_chunk: Optional[int] = None,
 ) -> jax.Array:
     """Optimize a per-step unconditional embedding that makes CFG denoising
     replay the recorded inversion trajectory (run_videop2p.py:580-612).
@@ -113,6 +119,12 @@ def null_text_optimization(
     call (the reference's ``get_noise_pred_single``/``get_noise_pred``,
     run_videop2p.py:465-487; gradients flow through the ``(1-w)·ε̂`` term
     only) — so the objective matches the model that produced the trajectory.
+
+    ``outer_chunk``: split the outer scan into host-level jitted chunks of
+    this many steps (one compile, several executions). At SD scale the full
+    50-step program is a single multi-minute device call, which the TPU
+    runtime's execution watchdog kills — chunking keeps each call short.
+    Only valid OUTSIDE jit (the function then jits its own chunk scan).
     """
     if dependent_weight > 0.0 and dependent_sampler is None:
         raise ValueError("dependent_weight > 0 requires dependent_sampler")
@@ -137,7 +149,7 @@ def null_text_optimization(
         return (1.0 - dependent_weight) * eps + dependent_weight * ar_noise
 
     def outer(carry, xs):
-        latent_cur, uncond, key = carry
+        latent_cur, uncond, key, params, cond_embedding = carry
         t, latent_prev, lr, thresh = xs
         key, k_cond, k_fu, k_fc = jax.random.split(key, 4)
         eps, _ = unet_fn(params, latent_cur, t, cond_embedding, None)
@@ -177,10 +189,51 @@ def null_text_optimization(
         eps_c = blend(eps_cond_raw, k_fc)
         eps = eps_uncond + guidance_scale * (eps_c - eps_uncond)
         latent_cur = scheduler.prev_step(eps, t, latent_cur, num_inference_steps)
-        return (latent_cur, uncond, key), uncond
+        return (latent_cur, uncond, key, params, cond_embedding), uncond
 
     x_t = trajectory[-1]
-    (_, _, _), uncond_seq = jax.lax.scan(
-        outer, (x_t, uncond_embedding, key), (timesteps, prev_seq, lr_seq, thresh_seq)
+    xs = (timesteps, prev_seq, lr_seq, thresh_seq)
+
+    def small_body(c, x):
+        # params/cond are scan CONSTANTS (closure), never carry — a carried
+        # tree is held twice inside the executable (carry-in + carry-out),
+        # which for SD-scale params tips a 16 GB chip into OOM
+        lat, unc, k = c
+        (lat, unc, k, _, _), y = outer((lat, unc, k, params, cond_embedding), x)
+        return (lat, unc, k), y
+
+    if not outer_chunk or outer_chunk >= num_inference_steps:
+        _, uncond_seq = jax.lax.scan(small_body, (x_t, uncond_embedding, key), xs)
+        return uncond_seq
+
+    # chunked path: params/cond enter as plain jit inputs (same no-carry rule
+    # as above), and the jitted chunk scan is cached on the statics its
+    # closure bakes in so repeat calls reuse the compiled program
+    cache_key = (
+        unet_fn, id(scheduler), id(dependent_sampler), float(guidance_scale),
+        int(num_inner_steps), int(num_inference_steps), float(dependent_weight),
     )
-    return uncond_seq
+    chunk_scan = _CHUNK_SCAN_CACHE.get(cache_key)
+    if chunk_scan is None:
+
+        def chunk_fn(p, cond, small_carry, chunk_xs):
+            def body(c, x):
+                lat, unc, k = c
+                (lat, unc, k, _, _), y = outer((lat, unc, k, p, cond), x)
+                return (lat, unc, k), y
+
+            return jax.lax.scan(body, small_carry, chunk_xs)
+
+        while len(_CHUNK_SCAN_CACHE) >= _CHUNK_SCAN_CACHE_MAX:
+            # bounded: fresh unet_fn/scheduler objects per pipeline would
+            # otherwise pin executables forever in a long-lived process
+            _CHUNK_SCAN_CACHE.pop(next(iter(_CHUNK_SCAN_CACHE)))
+        chunk_scan = jax.jit(chunk_fn)
+        _CHUNK_SCAN_CACHE[cache_key] = chunk_scan
+    small = (x_t, uncond_embedding, key)
+    pieces = []
+    for start in range(0, num_inference_steps, outer_chunk):
+        chunk = jax.tree.map(lambda a: a[start : start + outer_chunk], xs)
+        small, seq = chunk_scan(params, cond_embedding, small, chunk)
+        pieces.append(seq)
+    return jnp.concatenate(pieces, axis=0)

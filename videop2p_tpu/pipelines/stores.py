@@ -39,6 +39,14 @@ def _select_blend_leaves(
     return out
 
 
+def _cross_site_sizes(store: Dict[str, Any], text_len: int) -> List[int]:
+    return sorted({
+        leaf.shape[-2]
+        for path, leaf in flatten_store(store)
+        if "attn2" in path and leaf.shape[-1] == text_len
+    })
+
+
 def blend_maps_from_store(
     store: Dict[str, Any],
     *,
@@ -62,10 +70,24 @@ def blend_maps_from_store(
     r = blend_res if blend_res is not None else (latent_hw[0] // 4, latent_hw[1] // 4)
     U = num_prompts if num_uncond < 0 else num_uncond
     leaves = _select_blend_leaves(store, r, text_len)
+    if not leaves and blend_res is None and latent_hw[0] == latent_hw[1]:
+        # the (latent/4)² rule generalizes the reference's hard-coded 16×16
+        # (run_videop2p.py:146) but small/tiny UNets may have no site at that
+        # grid — fall back to the nearest square cross-site resolution
+        # (trace-time selection on concrete shapes)
+        sizes = _cross_site_sizes(store, text_len)
+        target = r[0] * r[1]
+        squares = [q for q in sizes if int(q ** 0.5) ** 2 == q]
+        if squares:
+            q = min(squares, key=lambda s: abs(s - target))
+            side = int(q ** 0.5)
+            r = (side, side)
+            leaves = _select_blend_leaves(store, r, text_len)
     if not leaves:
         raise ValueError(
             f"no cross-attention maps at blend resolution {r} in store "
-            f"(text_len={text_len}) — latent_hw mismatch?"
+            f"(text_len={text_len}, available query sizes "
+            f"{_cross_site_sizes(store, text_len)}) — latent_hw mismatch?"
         )
     stacked = jnp.stack(leaves, axis=1)  # ((U+P)·F, S, Q, L)
     _, s, q, L = stacked.shape

@@ -4,5 +4,14 @@ every import of them is gated)."""
 
 from videop2p_tpu.ui.trainer import Trainer, find_exp_dirs, save_model_card
 from videop2p_tpu.ui.inference import InferencePipeline
+from videop2p_tpu.ui.upload import ModelUploader, Uploader, UploadTarget
 
-__all__ = ["Trainer", "InferencePipeline", "find_exp_dirs", "save_model_card"]
+__all__ = [
+    "Trainer",
+    "InferencePipeline",
+    "find_exp_dirs",
+    "save_model_card",
+    "ModelUploader",
+    "Uploader",
+    "UploadTarget",
+]

@@ -1,4 +1,4 @@
-"""Gradio demo app: one-shot tuning tab + P2P editing tab + inference tab.
+"""Gradio demo app: tuning, P2P editing, inference, and HF-upload tabs.
 
 Re-design of /root/reference/app_gradio.py + gradio_utils/app_training.py:
 the tabs collect the same fields (video, prompts, blend words, equalizer,
@@ -16,6 +16,7 @@ import os
 
 from videop2p_tpu.ui.inference import InferencePipeline
 from videop2p_tpu.ui.trainer import Trainer, find_exp_dirs
+from videop2p_tpu.ui.upload import ModelUploader, UploadTarget
 
 DEFAULT_BASE_MODEL = "runwayml/stable-diffusion-v1-5"
 
@@ -129,6 +130,29 @@ def build_app():
             sample_out = gr.Image(label="Sampled video")
             gr.Button("Sample").click(
                 do_infer, [exp_dir3, prompt3, steps3, guidance3, seed3], sample_out
+            )
+        with gr.Tab("Upload"):
+            # HF Hub distribution (reference app_upload.py:15-43)
+            uploader = ModelUploader(os.getenv("HF_TOKEN"))
+            exp_dir4 = gr.Dropdown(
+                label="Experiment", choices=find_exp_dirs(), allow_custom_value=True
+            )
+            model_name4 = gr.Textbox(label="Model name (defaults to dir name)")
+            upload_to4 = gr.Radio(
+                label="Upload to",
+                choices=[t.value for t in UploadTarget],
+                value=UploadTarget.MODEL_LIBRARY.value,
+            )
+            private4 = gr.Checkbox(label="Private", value=True)
+            delete4 = gr.Checkbox(label="Delete existing repo of the same name",
+                                  value=False)
+            token4 = gr.Text(label="Hugging Face write token",
+                             visible=not os.getenv("HF_TOKEN"))
+            upload_msg = gr.Markdown(label="Status")
+            gr.Button("Upload").click(
+                uploader.upload_model,
+                [exp_dir4, model_name4, upload_to4, private4, delete4, token4],
+                upload_msg,
             )
     return demo
 

@@ -1,0 +1,60 @@
+"""Training metrics: JSONL log + optional TensorBoard.
+
+The reference tracks training through HF Accelerate —
+``accelerator.init_trackers("text2video-fine-tune")`` and per-step
+``accelerator.log({"train_loss": ...})`` plus a tqdm postfix with
+``step_loss``/``lr`` (/root/reference/run_tuning.py:234,337,377-378). Here a
+:class:`MetricsLogger` appends one JSON object per logged step to
+``<run_dir>/metrics.jsonl`` (machine-readable for the bench/driver) and, when
+the ``tensorboard`` package is importable, mirrors scalars into
+``<run_dir>/tb/`` for the usual dashboard.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, Optional
+
+__all__ = ["MetricsLogger"]
+
+
+class MetricsLogger:
+    def __init__(self, run_dir: str, *, project: str = "text2video-fine-tune",
+                 use_tensorboard: bool = True):
+        self.run_dir = run_dir
+        os.makedirs(run_dir, exist_ok=True)
+        self.path = os.path.join(run_dir, "metrics.jsonl")
+        self._fh = open(self.path, "a", buffering=1)  # line-buffered
+        self._t0 = time.time()
+        self._tb = None
+        if use_tensorboard:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                self._tb = SummaryWriter(
+                    log_dir=os.path.join(run_dir, "tb"), comment=project
+                )
+            except Exception:
+                self._tb = None  # tensorboard optional; JSONL always written
+
+    def log(self, step: int, scalars: Dict[str, float]) -> None:
+        rec = {"step": int(step), "wall_s": round(time.time() - self._t0, 3)}
+        rec.update({k: float(v) for k, v in scalars.items()})
+        self._fh.write(json.dumps(rec) + "\n")
+        if self._tb is not None:
+            for k, v in scalars.items():
+                self._tb.add_scalar(k, float(v), int(step))
+
+    def close(self) -> None:
+        self._fh.close()
+        if self._tb is not None:
+            self._tb.close()
+
+    def __enter__(self) -> "MetricsLogger":
+        return self
+
+    def __exit__(self, *exc) -> Optional[bool]:
+        self.close()
+        return None
