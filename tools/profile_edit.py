@@ -85,7 +85,23 @@ def main():
     # frame-attention impl ablation at the edit batch
     abl = []
     if "ablate" in sys.argv:
-        for impl in ("flash", "chunked"):
+        # correctness: the rectangular flash fold must match dense
+        from videop2p_tpu.ops.attention import (
+            dense_frame_attention,
+            flash_rect_frame_attention,
+        )
+
+        qs = jax.random.normal(jax.random.key(11), (2, 4, 8, 1024, 64), jnp.bfloat16)
+        ks = jax.random.normal(jax.random.key(12), (2, 8, 1024, 64), jnp.bfloat16)
+        vs = jax.random.normal(jax.random.key(13), (2, 8, 1024, 64), jnp.bfloat16)
+        o_dense = jax.jit(dense_frame_attention)(qs, ks, vs)
+        o_rect = jax.jit(flash_rect_frame_attention)(qs, ks, vs)
+        import numpy as np
+        err = float(jnp.max(jnp.abs(o_dense.astype(jnp.float32) - o_rect.astype(jnp.float32))))
+        print(f"flash_rect vs dense max|Δ| = {err:.2e}")
+        assert err < 0.05, "flash_rect mismatch"
+
+        for impl in ("flash", "flash_rect", "chunked"):
             m2 = UNet3DConditionModel(
                 config=UNet3DConfig.sd15(frame_attention=impl), dtype=jnp.bfloat16
             )
