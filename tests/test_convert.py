@@ -282,3 +282,81 @@ def test_vae_matches_torch_reference():
     np.testing.assert_allclose(
         np.asarray(img), np.transpose(t_img.numpy(), (0, 2, 3, 1)), atol=5e-5
     )
+
+
+def test_attention_maps_match_torch_reference():
+    """BASELINE's 'pixel-identical attention maps': the head-averaged
+    cross-attention probabilities the flax UNet sows into its store must
+    match the torch mirror's probabilities at every controlled site."""
+    import torch
+
+    from tests import torch_ref
+    from videop2p_tpu.pipelines.stores import flatten_store
+
+    cfg = UNet3DConfig.tiny()
+    torch.manual_seed(7)
+    tmodel = torch_ref.TorchUNet3D(cfg).eval()
+    sd = {k: v.detach().numpy() for k, v in tmodel.state_dict().items()}
+
+    model = UNet3DConditionModel(config=cfg)
+    B, F, S = 1, 2, 8
+    x = np.random.RandomState(3).randn(B, F, S, S, cfg.in_channels).astype(np.float32)
+    ctx = np.random.RandomState(4).randn(B, 7, cfg.cross_attention_dim).astype(np.float32)
+    t = np.array([123], dtype=np.int32)
+    abstract = jax.eval_shape(
+        lambda: model.init(jax.random.key(0), jnp.asarray(x), jnp.asarray(t), jnp.asarray(ctx))
+    )["params"]
+    params, _ = unet3d_params_from_torch(sd, abstract)
+
+    _, store = model.apply(
+        {"params": params}, jnp.asarray(x), jnp.asarray(t), jnp.asarray(ctx),
+        mutable=["attn_store"],
+    )
+    flax_maps = {
+        path: np.asarray(leaf)
+        for path, leaf in flatten_store(dict(store)["attn_store"])
+        if "attn2" in path
+    }
+    assert flax_maps, "no cross maps sown"
+
+    # capture the torch mirror's cross-attention probabilities per site
+    torch_maps = []
+
+    class ProbeAttention(torch_ref._Attention):
+        def attend(self, q, k, v):
+            b, n, c = q.shape
+            h, d = self.heads, c // self.heads
+            qh = q.reshape(b, n, h, d).transpose(1, 2)
+            kh = k.reshape(b, k.shape[1], h, d).transpose(1, 2)
+            vh = v.reshape(b, v.shape[1], h, d).transpose(1, 2)
+            sim = torch.einsum("bhqd,bhkd->bhqk", qh, kh) * d**-0.5
+            probs = sim.float().softmax(dim=-1).to(q.dtype)
+            torch_maps.append(probs.mean(dim=1).detach().numpy())
+            out = torch.einsum("bhqk,bhkd->bhqd", probs, vh)
+            return out.transpose(1, 2).reshape(b, n, c)
+
+    for mod in tmodel.modules():
+        if isinstance(mod, torch_ref.BasicTransformerBlock):
+            probe = ProbeAttention(
+                mod.attn2.to_q.in_features, mod.attn2.to_k.in_features, mod.attn2.heads
+            )
+            probe.load_state_dict(mod.attn2.state_dict())
+            mod.attn2 = probe
+    with torch.no_grad():
+        tmodel(
+            torch.tensor(np.transpose(x, (0, 4, 1, 2, 3))),
+            torch.tensor(t), torch.tensor(ctx),
+        )
+
+    assert len(torch_maps) == len(flax_maps)
+    # flax store is tree-ordered (down → mid → up by name); torch hooks fire
+    # in forward order — the same order for this architecture
+    for (path, fm), tm in zip(sorted(flax_maps.items()), _forward_order(torch_maps, flax_maps)):
+        np.testing.assert_allclose(fm, tm, atol=2e-5, err_msg=path)
+
+
+def _forward_order(torch_maps, flax_maps):
+    """Map torch forward-order probes to the flax store's tree order."""
+    # forward order: down_blocks_0, mid, up_blocks_1; tree (alphabetical)
+    # order: down_blocks_0, mid_block, up_blocks_1 — identical here
+    return torch_maps

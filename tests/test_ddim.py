@@ -110,3 +110,32 @@ def test_add_noise_and_velocity():
     np.testing.assert_allclose(np.asarray(noisy), a * np.asarray(x) + b * np.asarray(n), rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(np.asarray(v), a * np.asarray(n) - b * np.asarray(x), rtol=1e-4, atol=1e-5)
     assert s.training_target(x, n, t) is v or np.allclose(np.asarray(s.training_target(x, n, t)), np.asarray(v))
+
+
+def test_from_config_maps_diffusers_keys():
+    """Stage-2 builds its scheduler from the checkpoint's
+    scheduler_config.json (run_videop2p.py:101-114) — known keys map,
+    unknown keys are ignored."""
+    from videop2p_tpu.core import DDIMScheduler
+
+    cfg = {
+        "_class_name": "DDIMScheduler",
+        "_diffusers_version": "0.11.1",
+        "beta_start": 0.00085,
+        "beta_end": 0.012,
+        "beta_schedule": "scaled_linear",
+        "clip_sample": False,
+        "set_alpha_to_one": False,
+        "steps_offset": 1,
+        "skip_prk_steps": True,  # PNDM leftover diffusers writes — ignored
+    }
+    s = DDIMScheduler.from_config(cfg)
+    assert s.steps_offset == 1
+    assert s.beta_schedule == "scaled_linear"
+    assert not s.clip_sample
+    ref = DDIMScheduler.create_sd(steps_offset=1)
+    np.testing.assert_allclose(
+        np.asarray(s.alphas_cumprod), np.asarray(ref.alphas_cumprod)
+    )
+    # the offset shifts the inference grid (dependent_ddim.py:205-210)
+    assert s.timesteps(50)[0] != DDIMScheduler.create_sd().timesteps(50)[0]

@@ -125,3 +125,34 @@ def test_inference_rejects_mismatched_inv_latent(tmp_path, monkeypatch):
     # run() would reject it for a 2-frame request; check the guard directly
     expected = (1, 2, 8, 8, 4)
     assert tuple(got.shape) != expected
+
+
+def test_metrics_logger_jsonl(tmp_path):
+    from videop2p_tpu.utils.metrics import MetricsLogger
+
+    with MetricsLogger(str(tmp_path), use_tensorboard=False) as m:
+        m.log(1, {"train_loss": 0.5, "lr": 3e-5})
+        m.log(2, {"train_loss": 0.25, "lr": 3e-5})
+    lines = [json.loads(l) for l in open(tmp_path / "metrics.jsonl")]
+    assert [l["step"] for l in lines] == [1, 2]
+    assert lines[1]["train_loss"] == 0.25
+    assert all("wall_s" in l for l in lines)
+
+
+def test_bundle_make_scheduler_uses_checkpoint_config():
+    from videop2p_tpu.cli.common import ModelBundle
+
+    b = ModelBundle(
+        unet=None, unet_params={}, vae=None, vae_params=None,
+        text_encoder=None, text_params=None, tokenizer=None,
+        random_init=True, source_dir=None,
+        scheduler_config={"steps_offset": 1, "beta_schedule": "scaled_linear",
+                          "beta_start": 0.00085, "beta_end": 0.012},
+    )
+    assert b.make_scheduler().steps_offset == 1
+    b2 = ModelBundle(
+        unet=None, unet_params={}, vae=None, vae_params=None,
+        text_encoder=None, text_params=None, tokenizer=None,
+        random_init=True, source_dir=None,
+    )
+    assert b2.make_scheduler().steps_offset == 0  # SD default fallback
