@@ -1,0 +1,62 @@
+"""Data-layer tests: single-video dataset sampling/normalization and the
+Stage-2 frame loader's crop semantics (reference dataset.py + load_512_seq,
+run_videop2p.py:413-440)."""
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from videop2p_tpu.data import SingleVideoDataset, load_frame_sequence
+from videop2p_tpu.data.dataset import _numeric_sort
+
+
+@pytest.fixture()
+def frame_dir(tmp_path):
+    # 12 numbered frames, non-square (80×60), each a solid gray = its index
+    for i in range(1, 13):
+        arr = np.full((60, 80, 3), i * 10, np.uint8)
+        Image.fromarray(arr).save(tmp_path / f"{i}.jpg", quality=95)
+    return str(tmp_path)
+
+
+def test_numeric_sort_matches_reference():
+    names = [f"{i}.jpg" for i in range(1, 12)]
+    import random
+
+    shuffled = names[:]
+    random.Random(0).shuffle(shuffled)
+    # '10.jpg' must come after '9.jpg' (int sort, not lexicographic —
+    # dataset.py:37)
+    assert _numeric_sort(shuffled) == names
+
+
+def test_dataset_sampling_and_range(frame_dir):
+    ds = SingleVideoDataset(
+        video_path=frame_dir, prompt="p", width=16, height=16,
+        n_sample_frames=4, sample_start_idx=1, sample_frame_rate=2,
+    )
+    assert len(ds) == 1
+    clip = ds.load()
+    assert clip.shape == (4, 16, 16, 3)
+    assert clip.min() >= -1.0 and clip.max() <= 1.0
+    # frames 2, 4, 6, 8 (1-based names; start 1, stride 2) → gray 20,40,60,80
+    means = ((clip.mean(axis=(1, 2, 3)) + 1) * 127.5).round()
+    np.testing.assert_allclose(means, [20, 40, 60, 80], atol=2)
+
+    with pytest.raises(ValueError, match="exceed"):
+        SingleVideoDataset(
+            video_path=frame_dir, prompt="p", n_sample_frames=8,
+            sample_start_idx=0, sample_frame_rate=2,
+        ).load()
+
+
+def test_frame_sequence_center_square_crop(frame_dir):
+    seq = load_frame_sequence(frame_dir, size=32, num_frames=3)
+    assert seq.shape == (3, 32, 32, 3)
+    assert seq.dtype == np.uint8
+    # solid frames survive the crop+resize as the same gray value
+    np.testing.assert_allclose(seq[1].mean(), 20, atol=2)
+
+    # edge-crop args remove rows/cols before the square crop
+    seq2 = load_frame_sequence(frame_dir, size=16, num_frames=1, left=10, top=5)
+    assert seq2.shape == (1, 16, 16, 3)

@@ -216,3 +216,15 @@ def test_sharded_controlled_edit_matches_unsharded(mesh8):
     np.testing.assert_allclose(
         np.asarray(out_single), np.asarray(out_sharded), atol=2e-4
     )
+
+
+def test_hybrid_mesh_single_slice_and_distributed_noop():
+    """make_hybrid_mesh on one slice equals the plain reshape;
+    initialize_distributed is a no-op without multi-host config."""
+    from videop2p_tpu.parallel import initialize_distributed, make_hybrid_mesh
+
+    assert initialize_distributed() == 0
+    m = make_hybrid_mesh(1, 4, 2)
+    assert m.shape == {"data": 1, "frames": 4, "tensor": 2}
+    with pytest.raises(ValueError, match="needs"):
+        make_hybrid_mesh(2, 4, 2)

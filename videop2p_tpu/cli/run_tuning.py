@@ -323,6 +323,11 @@ if __name__ == "__main__":
                         help="device mesh 1,sp,tp (frames/tensor sharding)")
     add_dependent_args(parser)
     args = parser.parse_args()
+    # multi-host: join the process group before any device use (no-op on a
+    # single host; see parallel/distributed.py)
+    from videop2p_tpu.parallel import initialize_distributed
+
+    initialize_distributed()
     cfg = load_config(args.config)
     args.mesh = args.mesh or cfg.pop("mesh", None)
     main(

@@ -210,6 +210,10 @@ def main(
 
     null_embeddings = None
     if not fast:
+        # loaded executables count against HBM: drop the inversion program
+        # before compiling the null-text grad program, and that one before
+        # the CFG edit (a 16 GB chip OOMs with all three resident)
+        jax.clear_caches()
         key, nk = jax.random.split(key)
         with phase_timer("null_text_optimization"):
             null_embeddings = null_text_optimization(
@@ -224,6 +228,7 @@ def main(
                 outer_chunk=10,
             )
             null_embeddings = jax.block_until_ready(null_embeddings)
+        jax.clear_caches()
 
     # ---- controller + controlled denoise --------------------------------
     print("Start Video-P2P!")
@@ -287,6 +292,11 @@ if __name__ == "__main__":
                         help="per-frame text-embedding mode")
     add_dependent_args(parser)
     args = parser.parse_args()
+    # multi-host: join the process group before any device use (no-op on a
+    # single host; see parallel/distributed.py)
+    from videop2p_tpu.parallel import initialize_distributed
+
+    initialize_distributed()
     cfg = load_config(args.config)
     # flags win over config for the keys both surfaces expose
     args.multi = args.multi or bool(cfg.pop("multi", False))

@@ -76,6 +76,10 @@ class UNet3DConfig:
     flip_sin_to_cos: bool = True
     freq_shift: float = 0.0
     gradient_checkpointing: bool = False
+    # jax.checkpoint_policies name for remat (None → full recompute inside
+    # each block; "dots_with_no_batch_dims_saveable" keeps matmul outputs,
+    # trading HBM for less backward recompute)
+    remat_policy: Optional[str] = None
     # frame-attention kernel: "auto"/"dense" (inference), "chunked"
     # (training: memory-bounded backward), "flash" (Pallas; see ops/attention.py)
     frame_attention: str = "auto"
@@ -195,6 +199,7 @@ class UNet3DConditionModel(nn.Module):
             block = unet_blocks.get_down_block(
                 block_type,
                 remat=cfg.gradient_checkpointing,
+                remat_policy=cfg.remat_policy,
                 out_channels=cfg.block_out_channels[i],
                 num_layers=cfg.layers_per_block,
                 transformer_depth=depths[i],
@@ -214,7 +219,10 @@ class UNet3DConditionModel(nn.Module):
 
         # --- mid (unet.py:377) ---
         mid_cls = (
-            nn.remat(unet_blocks.UNetMidBlock3DCrossAttn)
+            nn.remat(
+                unet_blocks.UNetMidBlock3DCrossAttn,
+                policy=unet_blocks.resolve_remat_policy(cfg.remat_policy),
+            )
             if cfg.gradient_checkpointing
             else unet_blocks.UNetMidBlock3DCrossAttn
         )
@@ -241,6 +249,7 @@ class UNet3DConditionModel(nn.Module):
             block = unet_blocks.get_up_block(
                 block_type,
                 remat=cfg.gradient_checkpointing,
+                remat_policy=cfg.remat_policy,
                 out_channels=rev_channels[i],
                 num_layers=num_layers,
                 transformer_depth=rev_depths[i],

@@ -224,27 +224,38 @@ _ATTN_ONLY_KWARGS = (
 )
 
 
-def _make(mod_cls, remat: bool, kwargs):
+def resolve_remat_policy(name):
+    """jax.checkpoint_policies entry by name (None → full recompute)."""
+    if name is None:
+        return None
+    import jax
+
+    return getattr(jax.checkpoint_policies, name)
+
+
+def _make(mod_cls, remat: bool, kwargs, policy=None):
     if remat:
-        mod_cls = nn.remat(mod_cls)
+        mod_cls = nn.remat(mod_cls, policy=resolve_remat_policy(policy))
     return mod_cls(**kwargs)
 
 
-def get_down_block(block_type: str, *, remat: bool = False, **kwargs):
+def get_down_block(block_type: str, *, remat: bool = False,
+                   remat_policy=None, **kwargs):
     """Factory mirroring unet_blocks.py:11-65; raises on unknown types."""
     if block_type == "CrossAttnDownBlock3D":
-        return _make(CrossAttnDownBlock3D, remat, kwargs)
+        return _make(CrossAttnDownBlock3D, remat, kwargs, remat_policy)
     if block_type == "DownBlock3D":
         kwargs = {k: v for k, v in kwargs.items() if k not in _ATTN_ONLY_KWARGS}
-        return _make(DownBlock3D, remat, kwargs)
+        return _make(DownBlock3D, remat, kwargs, remat_policy)
     raise ValueError(f"unknown down block type: {block_type!r}")
 
 
-def get_up_block(block_type: str, *, remat: bool = False, **kwargs):
+def get_up_block(block_type: str, *, remat: bool = False,
+                 remat_policy=None, **kwargs):
     """Factory mirroring unet_blocks.py:68-122; raises on unknown types."""
     if block_type == "CrossAttnUpBlock3D":
-        return _make(CrossAttnUpBlock3D, remat, kwargs)
+        return _make(CrossAttnUpBlock3D, remat, kwargs, remat_policy)
     if block_type == "UpBlock3D":
         kwargs = {k: v for k, v in kwargs.items() if k not in _ATTN_ONLY_KWARGS}
-        return _make(UpBlock3D, remat, kwargs)
+        return _make(UpBlock3D, remat, kwargs, remat_policy)
     raise ValueError(f"unknown up block type: {block_type!r}")

@@ -11,6 +11,10 @@ from videop2p_tpu.parallel.mesh import (
     shard_array,
     text_sharding,
 )
+from videop2p_tpu.parallel.distributed import (
+    initialize_distributed,
+    make_hybrid_mesh,
+)
 from videop2p_tpu.parallel.ring import (
     make_ring_temporal_fn,
     ring_attention,
@@ -27,6 +31,8 @@ __all__ = [
     "replicated",
     "shard_array",
     "text_sharding",
+    "initialize_distributed",
+    "make_hybrid_mesh",
     "make_ring_temporal_fn",
     "ring_attention",
     "ring_attention_sharded",

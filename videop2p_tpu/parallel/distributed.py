@@ -1,0 +1,109 @@
+"""Multi-host bootstrap: process-group init and ICI×DCN hybrid meshes.
+
+The reference's only process boundary is HF Accelerate's torch.distributed
+launch (run_tuning.py:85-88; NCCL under the hood). The TPU-native equivalent
+is ``jax.distributed.initialize()`` once per host — after which
+``jax.devices()`` spans every host and the same ``Mesh``/``NamedSharding``
+code paths scale out, with XLA routing collectives over ICI within a slice
+and DCN across slices.
+
+``make_hybrid_mesh`` places the mesh axes so that the high-traffic axes
+(``frames``/``tensor`` — activation-sized collectives every layer) ride ICI
+and only ``data`` (gradient/loss reductions once per step) crosses DCN —
+the standard slow-outer/fast-inner hybrid layout.
+
+Single-host processes (including the one-chip bench environment and the
+virtual CPU mesh used by tests) need none of this; ``initialize_distributed``
+is a no-op for them.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from videop2p_tpu.parallel.mesh import AXIS_DATA, AXIS_FRAMES, AXIS_TENSOR
+
+__all__ = ["initialize_distributed", "make_hybrid_mesh"]
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> int:
+    """Join the multi-host process group; returns this host's process index.
+
+    With no arguments, reads the standard env vars (JAX auto-detects on TPU
+    pods via the metadata server; ``JAX_COORDINATOR_ADDRESS`` /
+    ``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID`` override). A plain single-host
+    run — nothing configured — is a no-op returning 0.
+    """
+    try:  # private API; absence just means "can't detect prior init"
+        already = getattr(jax._src.distributed.global_state, "client", None)
+    except AttributeError:
+        already = None
+    if already is not None:
+        return jax.process_index()
+    coordinator_address = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    env_np = os.environ.get("JAX_NUM_PROCESSES")
+    num_processes = num_processes if num_processes is not None else (
+        int(env_np) if env_np else None
+    )
+    env_pid = os.environ.get("JAX_PROCESS_ID")
+    process_id = process_id if process_id is not None else (
+        int(env_pid) if env_pid else None
+    )
+    if coordinator_address is None and num_processes is None:
+        return 0  # single host, nothing to join
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return jax.process_index()
+
+
+def make_hybrid_mesh(
+    dp: int,
+    sp: int,
+    tp: int,
+    *,
+    axis_names: Tuple[str, str, str] = (AXIS_DATA, AXIS_FRAMES, AXIS_TENSOR),
+) -> Mesh:
+    """(dp, sp, tp) mesh with DCN-crossing traffic confined to ``data``.
+
+    Uses ``mesh_utils.create_hybrid_device_mesh`` when the process spans
+    multiple slices/granules (data parallel across DCN, frames/tensor within
+    a slice over ICI); falls back to a plain device reshape on one slice —
+    where it is exactly ``make_mesh``.
+    """
+    devices = jax.devices()
+    n = dp * sp * tp
+    if n != len(devices):
+        raise ValueError(f"mesh ({dp},{sp},{tp}) needs {n} devices, have {len(devices)}")
+    num_granules = getattr(devices[0], "slice_index", None)
+    n_slices = (
+        len({getattr(d, "slice_index", 0) for d in devices})
+        if num_granules is not None
+        else 1
+    )
+    if n_slices > 1:
+        from jax.experimental import mesh_utils
+
+        if dp % n_slices:
+            raise ValueError(
+                f"data axis {dp} must be a multiple of the {n_slices} slices "
+                "so only gradient reductions cross DCN"
+            )
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(dp // n_slices, sp, tp),
+            dcn_mesh_shape=(n_slices, 1, 1),
+            devices=devices,
+        )
+        return Mesh(dev_array, axis_names)
+    return Mesh(np.asarray(devices).reshape(dp, sp, tp), axis_names)
