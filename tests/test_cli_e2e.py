@@ -1,0 +1,87 @@
+"""End-to-end CLI tests: the full two-stage flow on tiny random-init models.
+
+Covers the filesystem contract between stages (suffix mangling + resolution,
+diffusers-layout checkpoint, scheduler config), metrics logging, inversion,
+controller construction from config-shaped inputs, LocalBlend (and the
+no-blend path), and GIF artifacts — the same flow as
+``run_tuning.py`` → ``run_videop2p.py`` in the reference.
+"""
+
+import json
+import os
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope="module")
+def tuned_dir(tmp_path_factory):
+    from videop2p_tpu.cli.run_tuning import main as tune
+
+    root = tmp_path_factory.mktemp("e2e")
+    out = tune(
+        pretrained_model_path=str(root / "no_ckpt"),
+        output_dir=str(root / "exp"),
+        train_data={
+            "video_path": "data/rabbit", "prompt": "a rabbit is jumping",
+            "n_sample_frames": 2, "width": 16, "height": 16,
+        },
+        validation_data={
+            "prompts": ["a origami rabbit"], "num_inference_steps": 2,
+            "num_inv_steps": 2, "guidance_scale": 7.5, "use_inv_latent": True,
+        },
+        max_train_steps=3, validation_steps=3, checkpointing_steps=3,
+        tiny=True, mixed_precision="no", log_every=1,
+    )
+    return out
+
+
+def test_stage1_artifacts(tuned_dir):
+    # diffusers-layout pipeline dir + metrics + validation latents/samples
+    assert os.path.isfile(os.path.join(tuned_dir, "model_index.json"))
+    assert os.path.isdir(os.path.join(tuned_dir, "unet"))
+    sched_cfg = json.load(
+        open(os.path.join(tuned_dir, "scheduler", "scheduler_config.json"))
+    )
+    assert sched_cfg["steps_offset"] == 1
+    metrics = [json.loads(l) for l in open(os.path.join(tuned_dir, "metrics.jsonl"))]
+    assert [m["step"] for m in metrics] == [1, 2, 3]
+    assert os.path.isdir(os.path.join(tuned_dir, "inv_latents"))
+
+
+def test_stage2_fast_edit_with_blend(tuned_dir):
+    from videop2p_tpu.cli.run_videop2p import main as p2p
+
+    # pass the UNSUFFIXED experiment root: the resolver must find the
+    # suffixed pipeline dir Stage-1 wrote
+    base = tuned_dir.rsplit("_dependent", 1)[0]
+    inv_gif, edit_gif = p2p(
+        pretrained_model_path=base,
+        image_path="data/rabbit",
+        prompt="a rabbit is jumping",
+        prompts=["a rabbit is jumping", "a origami rabbit is jumping"],
+        save_name="origami", is_word_swap=False,
+        blend_word=["rabbit", "rabbit"],
+        eq_params={"words": ["origami"], "values": [2.0]},
+        video_len=2, fast=True, tiny=True,
+    )
+    assert os.path.isfile(inv_gif) and os.path.isfile(edit_gif)
+    assert tuned_dir in edit_gif  # results land inside the suffixed dir
+
+
+def test_stage2_no_blend_path(tuned_dir):
+    """bird-forest style edit: refine controller, custom replace ratios, NO
+    LocalBlend (configs/bird-forest-p2p.yaml has no blend_word)."""
+    from videop2p_tpu.cli.run_videop2p import main as p2p
+
+    inv_gif, edit_gif = p2p(
+        pretrained_model_path=tuned_dir,  # already-suffixed dir also works
+        image_path="data/rabbit",
+        prompt="a rabbit is jumping",
+        prompts=["a rabbit is jumping", "a crochet rabbit is jumping"],
+        save_name="crochet", is_word_swap=False,
+        cross_replace_steps=0.8, self_replace_steps=0.7,
+        video_len=2, fast=True, tiny=True,
+    )
+    assert os.path.isfile(edit_gif)

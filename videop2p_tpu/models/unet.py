@@ -77,8 +77,10 @@ class UNet3DConfig:
     freq_shift: float = 0.0
     gradient_checkpointing: bool = False
     # jax.checkpoint_policies name for remat (None → full recompute inside
-    # each block; "dots_with_no_batch_dims_saveable" keeps matmul outputs,
-    # trading HBM for less backward recompute)
+    # each block). Measured on v5e at the SD null-text working point:
+    # "dots_with_no_batch_dims_saveable" was 2.8× SLOWER (187 s → 521 s) —
+    # the saved dot outputs push a 16 GB chip into spills — so full
+    # recompute is the default; the knob stays for bigger-HBM parts.
     remat_policy: Optional[str] = None
     # frame-attention kernel: "auto"/"dense" (inference), "chunked"
     # (training: memory-bounded backward), "flash" (Pallas; see ops/attention.py)
