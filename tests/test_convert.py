@@ -360,3 +360,36 @@ def _forward_order(torch_maps, flax_maps):
     # forward order: down_blocks_0, mid, up_blocks_1; tree (alphabetical)
     # order: down_blocks_0, mid_block, up_blocks_1 — identical here
     return torch_maps
+
+
+def test_exported_state_dict_loads_into_torch_reference(tiny_unet_params):
+    """Stage-1's export must be consumable by the reference architecture:
+    the torch mirror load_state_dict()s our exported dict strictly, and its
+    forward matches the flax forward."""
+    import torch
+
+    from tests.torch_ref import TorchUNet3D
+
+    cfg, model, params, sample, text = tiny_unet_params
+    sd = unet3d_params_to_torch(params)
+    tmodel = TorchUNet3D(cfg)
+    missing, unexpected = tmodel.load_state_dict(
+        {k: torch.tensor(np.ascontiguousarray(v)) for k, v in sd.items()}, strict=True
+    )
+    assert not missing and not unexpected
+    tmodel.eval()
+    t = np.array([42], dtype=np.int32)
+    out_flax = model.apply(
+        {"params": params}, sample, jnp.asarray(t), text
+    )
+    with torch.no_grad():
+        out_torch = tmodel(
+            torch.tensor(np.transpose(np.asarray(sample), (0, 4, 1, 2, 3))),
+            torch.tensor(t),
+            torch.tensor(np.asarray(text)),
+        )
+    np.testing.assert_allclose(
+        np.asarray(out_flax),
+        np.transpose(out_torch.numpy(), (0, 2, 3, 4, 1)),
+        atol=5e-4,  # f32 reduction-order noise at flax-init weight scales
+    )
