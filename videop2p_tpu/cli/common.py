@@ -25,8 +25,46 @@ __all__ = [
     "resolve_pipeline_dir",
     "build_models",
     "encode_prompts",
+    "setup_mesh",
     "ModelBundle",
 ]
+
+
+def setup_mesh(bundle: "ModelBundle", mesh_spec: str, video_len: int):
+    """Parse a ``dp,sp,tp`` mesh spec and prepare the bundle for it: build
+    the device mesh, wire ring attention into the UNet's uncontrolled
+    temporal sites when frames are sharded, and shard the UNet params.
+    Returns the mesh. Both CLIs share this; single-clip flows need dp=1."""
+    from videop2p_tpu.parallel import (
+        make_mesh,
+        make_ring_temporal_fn,
+        param_shardings,
+    )
+
+    shape = tuple(int(t) for t in str(mesh_spec).split(","))
+    if len(shape) != 3:
+        raise ValueError(f"--mesh must be dp,sp,tp — got {mesh_spec!r}")
+    dp, sp, tp = shape
+    if dp != 1:
+        raise ValueError(
+            "single-clip flows run batch 1 — use dp=1 and put chips on the "
+            f"frame/tensor axes, got dp={dp}"
+        )
+    if video_len % sp:
+        raise ValueError(f"sp axis {sp} must divide video_len {video_len}")
+    device_mesh = make_mesh(shape)
+    print(f"[mesh] data={dp} frames={sp} tensor={tp}")
+    if sp > 1:
+        # ring attention on the uncontrolled temporal sites (training /
+        # inversion); controlled sites stay dense for the P2P edit
+        bundle.unet = bundle.unet.clone(
+            temporal_attention_fn=make_ring_temporal_fn(device_mesh)
+        )
+    bundle.unet_params = jax.device_put(
+        bundle.unet_params,
+        param_shardings(device_mesh, bundle.unet_params, tensor_parallel=tp > 1),
+    )
+    return device_mesh
 
 
 def load_config(path: str) -> Dict[str, Any]:

@@ -28,6 +28,7 @@ from videop2p_tpu.cli.common import (
     dependent_suffix,
     encode_prompts,
     load_config,
+    setup_mesh,
 )
 from videop2p_tpu.core import DDIMScheduler, DDPMScheduler, DependentNoiseSampler
 from videop2p_tpu.data import SingleVideoDataset
@@ -149,6 +150,14 @@ def main(
         train_batch_size=train_batch_size,
     )
     tx = make_optimizer(tune_cfg)
+    if mesh:
+        from videop2p_tpu.parallel import latent_sharding
+
+        # shard the bundle BEFORE TrainState.create so the partitioned
+        # trainable/frozen trees (and the optimizer state initialized from
+        # them) inherit the placements
+        device_mesh = setup_mesh(bundle, mesh, n_frames)
+        latents = jax.device_put(latents, latent_sharding(device_mesh))
     params = bundle.unet_params["params"]
     state = TrainState.create(params, tx, tune_cfg.trainable_modules)
 
@@ -164,38 +173,6 @@ def main(
             first_step = int(state.step)
             print(f"[tune] resumed from {path} at step {first_step}")
 
-    if mesh:
-        from videop2p_tpu.parallel import (
-            latent_sharding,
-            make_mesh,
-            make_ring_temporal_fn,
-            param_shardings,
-        )
-
-        shape = tuple(int(t) for t in str(mesh).split(","))
-        if len(shape) != 3 or shape[0] != 1:
-            raise ValueError(
-                f"--mesh must be 1,sp,tp for single-clip tuning, got {mesh!r}"
-            )
-        device_mesh = make_mesh(shape)
-        print(f"[tune] mesh: frames={shape[1]} tensor={shape[2]}")
-        if shape[1] > 1:
-            bundle.unet = bundle.unet.clone(
-                temporal_attention_fn=make_ring_temporal_fn(device_mesh)
-            )
-        tp = shape[2] > 1
-        state = state.replace(
-            trainable=jax.device_put(
-                state.trainable,
-                param_shardings(device_mesh, state.trainable, tensor_parallel=tp),
-            ),
-            frozen=jax.device_put(
-                state.frozen,
-                param_shardings(device_mesh, state.frozen, tensor_parallel=tp),
-            ),
-        )
-        latents = jax.device_put(latents, latent_sharding(device_mesh))
-
     noise_sched = DDPMScheduler.create_sd(prediction_type=prediction_type)
     unet_fn = make_unet_fn(bundle.unet)
     step_fn = jax.jit(
@@ -210,20 +187,25 @@ def main(
     lr_schedule = make_lr_schedule(tune_cfg)
     metrics = MetricsLogger(output_dir)
     losses = []
+
+    def flush_losses(next_step):
+        # one sync for the whole buffer (per-step float() would serialize
+        # host dispatch against device compute)
+        start = next_step - len(losses)
+        for j, lv in enumerate(np.asarray(jax.block_until_ready(jnp.stack(losses)))):
+            metrics.log(start + j + 1, {"train_loss": float(lv),
+                                        "lr": float(lr_schedule(start + j))})
+        last = float(losses[-1])
+        losses.clear()
+        return last
+
     t0 = time.time()
     for i in range(first_step, max_train_steps):
         key, sk = jax.random.split(key)
         state, loss = step_fn(state, sk)
         losses.append(loss)  # device-side; no per-step host sync
         if (i + 1) % log_every == 0 or i == first_step:
-            # flush the buffered losses in one sync (per-step float() would
-            # serialize host dispatch against device compute)
-            start = i + 1 - len(losses)
-            for j, lv in enumerate(np.asarray(jax.block_until_ready(jnp.stack(losses)))):
-                metrics.log(start + j + 1, {"train_loss": float(lv),
-                                            "lr": float(lr_schedule(start + j))})
-            loss = float(losses[-1])
-            losses = []
+            loss = flush_losses(i + 1)
             rate = (i + 1 - first_step) / max(time.time() - t0, 1e-9)
             print(f"[tune] step {i + 1}/{max_train_steps} loss={loss:.4f} "
                   f"({rate:.2f} it/s)")
@@ -236,10 +218,7 @@ def main(
                 text_emb=text_emb, key=key,
             )
     if losses:  # flush the tail of the buffer
-        start = max_train_steps - len(losses)
-        for j, lv in enumerate(np.asarray(jax.block_until_ready(jnp.stack(losses)))):
-            metrics.log(start + j + 1, {"train_loss": float(lv),
-                                        "lr": float(lr_schedule(start + j))})
+        flush_losses(max_train_steps)
     metrics.close()
 
     save_pipeline(

@@ -28,6 +28,7 @@ from videop2p_tpu.cli.common import (
     encode_prompts,
     load_config,
     resolve_pipeline_dir,
+    setup_mesh,
 )
 from videop2p_tpu.control import make_controller
 from videop2p_tpu.core import DependentNoiseSampler
@@ -127,37 +128,7 @@ def main(
         # per-block remat keeps that backward inside one chip's HBM
         gradient_checkpointing=not fast,
     )
-    device_mesh = None
-    if mesh:
-        from videop2p_tpu.parallel import (
-            make_mesh,
-            make_ring_temporal_fn,
-            param_shardings,
-        )
-
-        shape = tuple(int(t) for t in str(mesh).split(","))
-        if len(shape) != 3:
-            raise ValueError(f"--mesh must be dp,sp,tp — got {mesh!r}")
-        dp, sp, tp = shape
-        if dp != 1:
-            raise ValueError(
-                "Stage-2 edits one video (batch 1 through inversion) — use "
-                f"dp=1 and put chips on the frame/tensor axes, got dp={dp}"
-            )
-        if video_len % sp:
-            raise ValueError(f"sp axis {sp} must divide video_len {video_len}")
-        device_mesh = make_mesh(shape)
-        print(f"[p2p] mesh: data={dp} frames={sp} tensor={tp}")
-        if sp > 1:
-            # ring attention on the uncontrolled temporal sites (inversion /
-            # null-text); controlled sites stay dense for the P2P edit
-            bundle.unet = bundle.unet.clone(
-                temporal_attention_fn=make_ring_temporal_fn(device_mesh)
-            )
-        bundle.unet_params = jax.device_put(
-            bundle.unet_params,
-            param_shardings(device_mesh, bundle.unet_params, tensor_parallel=tp > 1),
-        )
+    device_mesh = setup_mesh(bundle, mesh, video_len) if mesh else None
 
     unet_fn = make_unet_fn(bundle.unet)
     params = bundle.unet_params

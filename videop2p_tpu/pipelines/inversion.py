@@ -194,16 +194,21 @@ def null_text_optimization(
     x_t = trajectory[-1]
     xs = (timesteps, prev_seq, lr_seq, thresh_seq)
 
-    def small_body(c, x):
-        # params/cond are scan CONSTANTS (closure), never carry — a carried
-        # tree is held twice inside the executable (carry-in + carry-out),
-        # which for SD-scale params tips a 16 GB chip into OOM
-        lat, unc, k = c
-        (lat, unc, k, _, _), y = outer((lat, unc, k, params, cond_embedding), x)
-        return (lat, unc, k), y
+    def make_body(p, cond):
+        # params/cond are scan CONSTANTS (closed over per scan), never carry
+        # — a carried tree is held twice inside the executable (carry-in +
+        # carry-out), which for SD-scale params tips a 16 GB chip into OOM
+        def body(c, x):
+            lat, unc, k = c
+            (lat, unc, k, _, _), y = outer((lat, unc, k, p, cond), x)
+            return (lat, unc, k), y
+
+        return body
 
     if not outer_chunk or outer_chunk >= num_inference_steps:
-        _, uncond_seq = jax.lax.scan(small_body, (x_t, uncond_embedding, key), xs)
+        _, uncond_seq = jax.lax.scan(
+            make_body(params, cond_embedding), (x_t, uncond_embedding, key), xs
+        )
         return uncond_seq
 
     # chunked path: params/cond enter as plain jit inputs (same no-carry rule
@@ -217,12 +222,7 @@ def null_text_optimization(
     if chunk_scan is None:
 
         def chunk_fn(p, cond, small_carry, chunk_xs):
-            def body(c, x):
-                lat, unc, k = c
-                (lat, unc, k, _, _), y = outer((lat, unc, k, p, cond), x)
-                return (lat, unc, k), y
-
-            return jax.lax.scan(body, small_carry, chunk_xs)
+            return jax.lax.scan(make_body(p, cond), small_carry, chunk_xs)
 
         while len(_CHUNK_SCAN_CACHE) >= _CHUNK_SCAN_CACHE_MAX:
             # bounded: fresh unet_fn/scheduler objects per pipeline would
