@@ -8,14 +8,17 @@ attached (one TPU v5e chip under axon). Weights are random-init: wall-clock
 of the jitted compute is weight-value-independent, and no SD checkpoint ships
 in this image.
 
-Also measures null-text inversion wall-clock (the official mode's dominant
-phase, README.md:59-60 "~10 min on V100"; the declared metric of record in
-BASELINE.json) unless ``VIDEOP2P_BENCH_FAST_ONLY=1``.
-
-Prints ONE JSON line:
+Prints ONE JSON line to stdout immediately after the fast phase:
   {"metric": "fast_edit_e2e_wall", "value": <seconds>, "unit": "s",
    "vs_baseline": <V100_baseline / ours>,   # >1 ⇒ faster than the reference
    "breakdown": {...per-phase seconds, per-step ms, frames/sec, MFU...}}
+
+Unless ``VIDEOP2P_BENCH_FAST_ONLY=1``, it then also measures null-text
+inversion wall-clock (the official mode's dominant phase, README.md:59-60
+"~10 min on V100"; a declared metric of record in BASELINE.json), the
+official-mode edit, and a Stage-1 tuning step — another ~25 minutes of
+compiles and runs — writing the extended breakdown to stderr and
+``bench_details.json`` so the primary line survives any harness timeout.
 """
 
 from __future__ import annotations
@@ -144,6 +147,22 @@ def main() -> None:
         breakdown["mfu_inversion"] = round(inv_flops / inv_s / peak, 3)
         breakdown["mfu_edit"] = round(edit_flops / edit_s / peak, 3)
 
+    # print the metric of record NOW: the extended phases below (null-text,
+    # official mode, tuning step) take ~25 more minutes of compiles and
+    # measured runs, and the primary line must survive a harness timeout
+    print(
+        json.dumps(
+            {
+                "metric": "fast_edit_e2e_wall",
+                "value": round(elapsed, 3),
+                "unit": "s",
+                "vs_baseline": round(V100_FAST_EDIT_S / elapsed, 2),
+                "breakdown": breakdown,
+            }
+        ),
+        flush=True,
+    )
+
     if os.environ.get("VIDEOP2P_BENCH_FAST_ONLY", "0") != "1":
         # Stage-1 tuning step at the reference working point (8 frames, 64²
         # latents, masked AdamW on the attention projections, per-block
@@ -238,17 +257,15 @@ def main() -> None:
         del state
         jax.clear_caches()
 
-    print(
-        json.dumps(
-            {
-                "metric": "fast_edit_e2e_wall",
-                "value": round(elapsed, 3),
-                "unit": "s",
-                "vs_baseline": round(V100_FAST_EDIT_S / elapsed, 2),
-                "breakdown": breakdown,
-            }
-        )
-    )
+        # extended metrics: stderr (stdout stays one JSON line) + a details
+        # file next to the repo for the record
+        import sys
+
+        details = {"extended_of": "fast_edit_e2e_wall", "breakdown": breakdown}
+        print(json.dumps(details), file=sys.stderr, flush=True)
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "bench_details.json"), "w") as f:
+            json.dump(details, f, indent=2)
 
 
 if __name__ == "__main__":
