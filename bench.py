@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 import jax
@@ -147,6 +148,19 @@ def main() -> None:
         breakdown["mfu_inversion"] = round(inv_flops / inv_s / peak, 3)
         breakdown["mfu_edit"] = round(edit_flops / edit_s / peak, 3)
 
+    # The BASELINE.json north-star (<10 s) is set for a v5e-4 slice; this
+    # harness has ONE chip. Project the 4-chip number from the measured
+    # single-chip wall-clock under the shipped sequence-parallel path
+    # (--mesh 1,4,1: frames shard over 4 chips, tests/test_parallel.py
+    # proves sharded==unsharded on a virtual mesh). Every per-frame op
+    # (convs, FF, norms, frame-attn queries) parallelizes cleanly; the
+    # collectives are the frame-0 KV broadcast (~8 MB/site) and the
+    # temporal-site K/V ring (~126 MB/step total) — ≤15 % of step time on
+    # ICI by the xplane op-level traffic analysis (tools/profile_xplane.py),
+    # hence the conservative 80 % parallel-efficiency factor.
+    SP, EFF = 4, 0.8
+    breakdown["projected_v5e4_s"] = round(elapsed / (SP * EFF), 1)
+
     # print the metric of record NOW: the extended phases below (null-text,
     # official mode, tuning step) take ~25 more minutes of compiles and
     # measured runs, and the primary line must survive a harness timeout
@@ -259,9 +273,11 @@ def main() -> None:
 
         # extended metrics: stderr (stdout stays one JSON line) + a details
         # file next to the repo for the record
-        import sys
-
-        details = {"extended_of": "fast_edit_e2e_wall", "breakdown": breakdown}
+        details = {
+            "extended_of": "fast_edit_e2e_wall",
+            "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "breakdown": breakdown,
+        }
         print(json.dumps(details), file=sys.stderr, flush=True)
         with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "bench_details.json"), "w") as f:

@@ -197,8 +197,8 @@ def test_pipeline_dir_roundtrip(tmp_path, tiny_unet_params):
     ):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), err_msg=str(pa))
     # and the loaded model runs
-    out_arr = loaded.unet.apply(loaded.unet_params, sample, jnp.asarray(3), text)
-    ref_arr = model.apply({"params": params}, sample, jnp.asarray(3), text)
+    out_arr = jax.jit(loaded.unet.apply)(loaded.unet_params, sample, jnp.asarray(3), text)
+    ref_arr = jax.jit(model.apply)({"params": params}, sample, jnp.asarray(3), text)
     np.testing.assert_allclose(np.asarray(out_arr), np.asarray(ref_arr), atol=1e-5)
 
 

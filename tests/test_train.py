@@ -179,17 +179,23 @@ def test_remat_policy_threads_through_blocks():
     text = jax.random.normal(jax.random.key(1), (1, 7, 16))
 
     grads = {}
+    params = None
     for policy in (None, "dots_saveable"):
         cfg = UNet3DConfig.tiny(gradient_checkpointing=True, remat_policy=policy)
         model = UNet3DConditionModel(config=cfg)
-        params = jax.jit(model.init)(jax.random.key(2), x, jnp.asarray(3), text)
+        if params is None:
+            # the param pytree is policy-independent — one init serves both
+            params = jax.jit(model.init)(jax.random.key(2), x, jnp.asarray(3), text)
         fn = make_unet_fn(model)
 
         def loss(p):
             out, _ = fn(p, x, jnp.asarray(3), text)
             return jnp.mean(out**2)
 
-        grads[policy] = jax.grad(loss)(params)
+        # jitted: eager (op-by-op) grad of even the tiny UNet costs ~minutes
+        # of dispatch overhead on this host, and only jitted programs hit the
+        # persistent compilation cache
+        grads[policy] = jax.jit(jax.grad(loss))(params)
     a = jax.tree_util.tree_leaves(grads[None])
     b = jax.tree_util.tree_leaves(grads["dots_saveable"])
     for ga, gb in zip(a, b):

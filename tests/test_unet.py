@@ -214,7 +214,12 @@ def test_sdxl_shaped_forward_and_torch_parity():
     )["params"]
     params, report = unet3d_params_from_torch(sd, abstract)
     assert report["kept_init"] == [] and report["unused"] == []
-    out_flax = model.apply({"params": params}, jnp.asarray(x), jnp.asarray(t), jnp.asarray(ctx))
+    # jitted: the eager op-by-op apply of this wider config costs ~35 s of
+    # dispatch overhead on the test host, and only jitted programs hit the
+    # persistent compilation cache
+    out_flax = jax.jit(model.apply)(
+        {"params": params}, jnp.asarray(x), jnp.asarray(t), jnp.asarray(ctx)
+    )
     with torch.no_grad():
         out_torch = tmodel(
             torch.tensor(np.transpose(x, (0, 4, 1, 2, 3))),

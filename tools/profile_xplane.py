@@ -1,0 +1,129 @@
+"""Op-family breakdown of the jitted fast-edit phases on the real chip.
+
+Runs the 50-step inversion + controlled edit under ``jax.profiler.trace`` and
+sums per-op device time from the raw ``*.xplane.pb`` (the tensorboard-plugin
+converter is broken in this image; parse the proto directly with the pure-
+Python protobuf implementation). Inputs are seeded from runtime entropy so the
+axon tunnel's server-side (executable, args) memoization cannot fake a cached
+run (see .claude/skills/verify/SKILL.md).
+
+Usage:  PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python python tools/profile_xplane.py
+"""
+
+from __future__ import annotations
+
+import collections
+import glob
+import os
+import re
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+
+import jax
+import jax.numpy as jnp
+
+
+def _op_family(name: str) -> str:
+    """Bucket an XLA op name into a coarse family."""
+    base = name.split(".")[0].split("%")[-1]
+    for fam in (
+        "convolution", "dot", "fusion", "copy", "transpose", "reshape",
+        "reduce", "broadcast", "convert", "all-gather", "all-reduce",
+        "dynamic-slice", "dynamic-update-slice", "scatter", "gather",
+        "custom-call", "rng", "iota", "slice", "concatenate", "pad",
+    ):
+        if base.startswith(fam):
+            return fam
+    return re.sub(r"[-_.]?\d+$", "", base) or base
+
+
+def collect(trace_dir: str) -> dict:
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    fams = collections.Counter()
+    total_ps = 0
+    for path in glob.glob(
+        os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True
+    ):
+        xspace = xplane_pb2.XSpace()
+        with open(path, "rb") as f:
+            xspace.ParseFromString(f.read())
+        for plane in xspace.planes:
+            if "TPU" not in plane.name and "/device" not in plane.name.lower():
+                continue
+            ev_names = {k: v.name for k, v in plane.event_metadata.items()}
+            for line in plane.lines:
+                if line.name != "XLA Ops":
+                    continue
+                for ev in line.events:
+                    name = ev_names.get(ev.metadata_id, "?")
+                    fams[_op_family(name)] += ev.duration_ps
+                    total_ps += ev.duration_ps
+    return {"families": fams, "total_ps": total_ps}
+
+
+def main() -> None:
+    from videop2p_tpu.control import make_controller
+    from videop2p_tpu.core import DDIMScheduler
+    from videop2p_tpu.models import UNet3DConditionModel, UNet3DConfig
+    from videop2p_tpu.pipelines import ddim_inversion, edit_sample, make_unet_fn
+    from videop2p_tpu.utils.tokenizers import WordTokenizer
+
+    cfg = UNet3DConfig.sd15()
+    model = UNet3DConditionModel(config=cfg, dtype=jnp.bfloat16)
+    F, STEPS = 8, 50
+    base = jax.random.key(time.time_ns() % (2**31))
+    k0, k1, k2, k7 = jax.random.split(base, 4)
+    x0 = jax.random.normal(k0, (1, F, 64, 64, 4), jnp.bfloat16)
+    cond = jax.random.normal(k1, (2, 77, 768), jnp.bfloat16)
+    uncond = jnp.zeros((77, 768), jnp.bfloat16)
+    params = jax.jit(model.init)(k2, x0, jnp.asarray(10), cond[:1])
+    params = jax.tree.map(lambda x: x.astype(jnp.bfloat16), params)
+    fn = make_unet_fn(model)
+    sched = DDIMScheduler.create_sd()
+    ctx = make_controller(
+        ["a rabbit is jumping on the grass",
+         "a origami rabbit is jumping on the grass"],
+        WordTokenizer(),
+        num_steps=STEPS,
+        is_replace_controller=False,
+        cross_replace_steps=0.2,
+        self_replace_steps=0.5,
+        blend_words=(["rabbit"], ["rabbit"]),
+        equalizer_params={"words": ["origami"], "values": [2.0]},
+    )
+    invert = jax.jit(
+        lambda p, x: ddim_inversion(fn, p, sched, x, cond[:1],
+                                    num_inference_steps=STEPS)
+    )
+    edit = jax.jit(
+        lambda p, xt: edit_sample(
+            fn, p, sched, xt, cond, uncond,
+            num_inference_steps=STEPS, ctx=ctx, source_uses_cfg=False,
+        )
+    )
+    # compile + warm on a different input (memoization defeat)
+    x_warm = jax.random.normal(k7, x0.shape, x0.dtype)
+    jax.block_until_ready(edit(params, invert(params, x_warm)[-1]))
+
+    trace_dir = sys.argv[1] if len(sys.argv) > 1 else tempfile.mkdtemp(
+        prefix="videop2p_xplane_"
+    )
+    with jax.profiler.trace(trace_dir):
+        traj = invert(params, x0)
+        out = edit(params, traj[-1])
+        jax.block_until_ready(out)
+
+    res = collect(trace_dir)
+    total = res["total_ps"] / 1e12
+    print(f"trace: {trace_dir}")
+    print(f"device op time total: {total:.3f} s")
+    for fam, ps in res["families"].most_common(20):
+        print(f"  {fam:24s} {ps/1e12:8.3f} s  {ps/res['total_ps']*100:5.1f}%")
+
+
+if __name__ == "__main__":
+    main()
