@@ -54,88 +54,157 @@ def _peak_flops() -> float:
     return float("nan")
 
 
-def main() -> None:
+def measure_with_floor(call, fresh_inputs, floor_s: float, what: str):
+    """Wall-clock ``call(x)`` and validate it against a physical floor.
+
+    The axon tunnel intermittently completes a repeat-shape execution
+    unphysically fast even with value-fresh arguments (a 187 s null-text
+    phase once "measured" 0.015 s — server-side caching/pipelining), so any
+    reading below ``floor_s`` — the MFU=1 bound from the phase's FLOP count —
+    is rejected and re-measured on the next fresh input. Fresh VALUES per
+    attempt are required: repeating identical (executable, args) is exactly
+    what the server legitimately memoizes. Returns ``(out, seconds,
+    suspect)``; ``suspect`` is True when no reading cleared the floor (the
+    max reading is reported). A NaN floor (unknown-peak device) accepts the
+    first reading.
+    """
+    dt_best, out = 0.0, None
+    for x in fresh_inputs:
+        t0 = time.time()
+        out = call(x)
+        jax.block_until_ready(out)
+        dt = time.time() - t0
+        dt_best = max(dt_best, dt)
+        if floor_s != floor_s or dt >= floor_s:
+            return out, dt, False
+        print(
+            f"[bench] {what}: {dt:.3f}s is below the physical floor "
+            f"{floor_s:.2f}s — re-measuring on a fresh input",
+            file=sys.stderr,
+            flush=True,
+        )
+    return out, dt_best, True
+
+
+def build_fast_edit_working_point(*, num_frames: int = 8, num_steps: int = 50,
+                                  frame_attention: str = "auto"):
+    """The reference's headline scenario, shared by the bench phases and the
+    xplane profiler (tools/profile_xplane.py): rabbit-jump-p2p refine +
+    reweight + LocalBlend at ``num_frames`` × 64×64 latents, ``num_steps``
+    DDIM, fast mode.
+
+    Returns a namespace with the jitted ``invert``/``edit`` plus every
+    intermediate the extended phases need (fn, params, sched, ctx, cond,
+    uncond, x0, x_warm, base key). Inputs are seeded from runtime entropy:
+    the axon tunnel memoizes repeated identical (executable, args) executions
+    SERVER-side, across processes — a fixed seed would let a later run replay
+    cached results in ~0 s — and the warm-up input differs from the measured
+    one for the same reason.
+    """
+    from types import SimpleNamespace
+
     from videop2p_tpu.control import make_controller
     from videop2p_tpu.core import DDIMScheduler
     from videop2p_tpu.models import UNet3DConditionModel, UNet3DConfig
-    from videop2p_tpu.pipelines import (
-        ddim_inversion,
-        edit_sample,
-        make_unet_fn,
-        null_text_optimization,
-    )
+    from videop2p_tpu.pipelines import ddim_inversion, edit_sample, make_unet_fn
     from videop2p_tpu.utils.tokenizers import WordTokenizer
 
-    cfg = UNet3DConfig.sd15()
-    model = UNet3DConditionModel(config=cfg, dtype=jnp.bfloat16)
-    F, STEPS = 8, 50
-    # seed from runtime entropy: the axon tunnel memoizes repeated identical
-    # (executable, args) executions SERVER-side, across processes — a fixed
-    # seed would let a later bench run replay cached results in ~0 s
+    model = UNet3DConditionModel(
+        config=UNet3DConfig.sd15(frame_attention=frame_attention),
+        dtype=jnp.bfloat16,
+    )
     base = jax.random.key(time.time_ns() % (2**31))
     k0, k1, k2, k7 = jax.random.split(base, 4)
-    x0 = jax.random.normal(k0, (1, F, 64, 64, 4), jnp.bfloat16)
+    x0 = jax.random.normal(k0, (1, num_frames, 64, 64, 4), jnp.bfloat16)
     cond = jax.random.normal(k1, (2, 77, 768), jnp.bfloat16)
     uncond = jnp.zeros((77, 768), jnp.bfloat16)
-    params = jax.jit(model.init)(k2, x0, jnp.asarray(10), cond[:1])
+    params = jax.jit(model.init)(k2, x0[:, :8], jnp.asarray(10), cond[:1])
     # bf16 weights: halves HBM and skips the per-use f32→bf16 kernel converts
     # (wall-clock is weight-value-independent; no f32 masters needed here)
     params = jax.tree.map(lambda x: x.astype(jnp.bfloat16), params)
     fn = make_unet_fn(model)
-    # null-text differentiates through the UNet — per-block rematerialization
-    # keeps the backward under one chip's HBM (dense backward OOMs at 16 GB)
-    model_remat = UNet3DConditionModel(
-        config=UNet3DConfig.sd15(gradient_checkpointing=True), dtype=jnp.bfloat16
-    )
-    fn_remat = make_unet_fn(model_remat)
     sched = DDIMScheduler.create_sd()
-
     # rabbit-jump-p2p working point: refine + reweight + LocalBlend
     # (configs/rabbit-jump-p2p.yaml)
     ctx = make_controller(
-        ["a rabbit is jumping on the grass", "a origami rabbit is jumping on the grass"],
+        ["a rabbit is jumping on the grass",
+         "a origami rabbit is jumping on the grass"],
         WordTokenizer(),
-        num_steps=STEPS,
+        num_steps=num_steps,
         is_replace_controller=False,
         cross_replace_steps=0.2,
         self_replace_steps=0.5,
         blend_words=(["rabbit"], ["rabbit"]),
         equalizer_params={"words": ["origami"], "values": [2.0]},
     )
-
     invert = jax.jit(
-        lambda p, x: ddim_inversion(fn, p, sched, x, cond[:1], num_inference_steps=STEPS)
+        lambda p, x: ddim_inversion(
+            fn, p, sched, x, cond[:1], num_inference_steps=num_steps
+        )
     )
     edit = jax.jit(
         lambda p, xt: edit_sample(
             fn, p, sched, xt, cond, uncond,
-            num_inference_steps=STEPS, ctx=ctx, source_uses_cfg=False,
+            num_inference_steps=num_steps, ctx=ctx, source_uses_cfg=False,
         )
     )
+    x_warm = jax.random.normal(k7, x0.shape, x0.dtype)
+    return SimpleNamespace(
+        invert=invert, edit=edit, fn=fn, params=params, sched=sched, ctx=ctx,
+        cond=cond, uncond=uncond, x0=x0, x_warm=x_warm, base=base,
+    )
+
+
+def main() -> None:
+    from videop2p_tpu.models import UNet3DConditionModel, UNet3DConfig
+    from videop2p_tpu.pipelines import edit_sample, make_unet_fn, null_text_optimization
+
+    F, STEPS = 8, 50
+    wp = build_fast_edit_working_point(num_frames=F, num_steps=STEPS)
+    invert, edit, params = wp.invert, wp.edit, wp.params
+    fn, sched, ctx = wp.fn, wp.sched, wp.ctx
+    cond, uncond, x0, x_warm, base = wp.cond, wp.uncond, wp.x0, wp.x_warm, wp.base
+    # null-text differentiates through the UNet — per-block rematerialization
+    # keeps the backward under one chip's HBM (dense backward OOMs at 16 GB)
+    model_remat = UNet3DConditionModel(
+        config=UNet3DConfig.sd15(gradient_checkpointing=True), dtype=jnp.bfloat16
+    )
+    fn_remat = make_unet_fn(model_remat)
 
     # warm-up (compile) on a DIFFERENT input: memoized identical calls would
     # fake a near-zero wall-clock for the measured run
-    x_warm = jax.random.normal(k7, x0.shape, x0.dtype)
     out = edit(params, invert(params, x_warm)[-1])
     jax.block_until_ready(out)
-
-    t0 = time.time()
-    traj = invert(params, x0)
-    jax.block_until_ready(traj)
-    t1 = time.time()
-    out = edit(params, traj[-1])
-    jax.block_until_ready(out)
-    t2 = time.time()
-    inv_s, edit_s = t1 - t0, t2 - t1
-    elapsed = t2 - t0
-
-    assert bool(jnp.isfinite(out.astype(jnp.float32)).all()), "non-finite output"
 
     peak = _peak_flops()
     # fast mode: inversion is 1 cond stream; the edit batch is 3 streams
     # (edit-uncond + 2 cond; the source's unused uncond forward is skipped)
     inv_flops = FLOPS_PER_FRAME_FWD * 1 * F * STEPS
     edit_flops = FLOPS_PER_FRAME_FWD * 3 * F * STEPS
+    suspect = []
+
+    k_r1, k_r2 = jax.random.split(jax.random.fold_in(base, 7))
+    traj, inv_s, bad = measure_with_floor(
+        lambda x: invert(params, x),
+        [x0] + [jax.random.normal(k, x0.shape, x0.dtype) for k in (k_r1, k_r2)],
+        inv_flops / peak,
+        "inversion",
+    )
+    if bad:
+        suspect.append("inversion_s")
+    out, edit_s, bad = measure_with_floor(
+        lambda xt: edit(params, xt),
+        # value-fresh x_T per attempt (wall-clock is value-independent)
+        [traj[-1], traj[-1] + 0.001, traj[-1] - 0.001],
+        edit_flops / peak,
+        "edit",
+    )
+    if bad:
+        suspect.append("edit_s")
+    elapsed = inv_s + edit_s
+
+    assert bool(jnp.isfinite(out.astype(jnp.float32)).all()), "non-finite output"
+
     breakdown = {
         "inversion_s": round(inv_s, 3),
         "edit_s": round(edit_s, 3),
@@ -147,6 +216,8 @@ def main() -> None:
     if peak == peak:  # known peak-FLOPs device only (NaN is not valid JSON)
         breakdown["mfu_inversion"] = round(inv_flops / inv_s / peak, 3)
         breakdown["mfu_edit"] = round(edit_flops / edit_s / peak, 3)
+    if suspect:
+        breakdown["suspect_measurements"] = suspect
 
     # The BASELINE.json north-star (<10 s) is set for a v5e-4 slice; this
     # harness has ONE chip. Project the 4-chip number from the measured
@@ -185,10 +256,13 @@ def main() -> None:
         from videop2p_tpu.core import DDPMScheduler
         from videop2p_tpu.train import TrainState, TuneConfig, make_optimizer, train_step
 
-        # warm inversion input for the null phase while the inversion
-        # executable is still loaded, then drop the fast-phase programs —
-        # each later phase needs the chip's HBM close to free
+        # warm inversion input for the null phase — plus a spare trajectory
+        # as the value-fresh retry input for the floor check — while the
+        # inversion executable is still loaded, then drop the fast-phase
+        # programs: each later phase needs the chip's HBM close to free
         warm_traj = jax.block_until_ready(invert(params, x_warm))
+        x_extra = jax.random.normal(jax.random.fold_in(base, 55), x0.shape, x0.dtype)
+        traj_extra = jax.block_until_ready(invert(params, x_extra))
         traj_last, warm_last = traj[-1], warm_traj[-1]
         del out
         jax.clear_caches()
@@ -211,19 +285,28 @@ def main() -> None:
             )
         )
         warm_null = jax.block_until_ready(null_opt(params, warm_traj))
-        t3 = time.time()
-        null_seq = null_opt(params, traj)
-        jax.block_until_ready(null_seq)
-        t4 = time.time()
-        del traj, warm_traj
+        # floor: even if every inner Adam loop early-stops at 0 iterations,
+        # each of the 50 outer steps runs 2 forwards (cond + final uncond)
+        null_seq, null_s, bad = measure_with_floor(
+            lambda tr: null_opt(params, tr),
+            [traj, traj_extra],
+            2 * STEPS * F * FLOPS_PER_FRAME_FWD / peak,
+            "null-text",
+        )
+        if bad:
+            suspect.append("null_text_wall_s")
+        del traj, warm_traj, traj_extra
         jax.clear_caches()
 
         jax.block_until_ready(edit_official(params, warm_last, warm_null))
-        t5 = time.time()
-        out_off = edit_official(params, traj_last, null_seq)
-        jax.block_until_ready(out_off)
-        t6 = time.time()
-        null_s, edit_off_s = t4 - t3, t6 - t5
+        out_off, edit_off_s, bad = measure_with_floor(
+            lambda xt: edit_official(params, xt, null_seq),
+            [traj_last, warm_last + 0.001],  # value-fresh x_T per attempt
+            4 * F * STEPS * FLOPS_PER_FRAME_FWD / peak,  # full CFG: 4 streams
+            "official edit",
+        )
+        if bad:
+            suspect.append("official_edit_s")
         breakdown["null_text_wall_s"] = round(null_s, 3)
         official = inv_s + null_s + edit_off_s
         breakdown["official_edit_s"] = round(edit_off_s, 3)
@@ -258,18 +341,65 @@ def main() -> None:
         )
         state, _ = step(state, k4)  # compile + step 1
         jax.block_until_ready(state.trainable)
-        t_tr = time.time()
         TRAIN_STEPS = 5
-        for i in range(TRAIN_STEPS):
-            state, loss_tr = step(state, jax.random.fold_in(k5, i))
-        jax.block_until_ready(loss_tr)
-        breakdown["tune_step_ms"] = round((time.time() - t_tr) / TRAIN_STEPS * 1e3, 1)
-        breakdown["tune_step_vs_t4"] = round(
-            4000.0 / breakdown["tune_step_ms"], 1
+        holder = {"state": state, "off": 0}
+
+        def tune_loop(_):
+            s = holder["state"]
+            for i in range(TRAIN_STEPS):
+                # the evolving state + per-attempt key offset keep every
+                # step's args value-fresh across retries
+                s, loss = step(s, jax.random.fold_in(k5, holder["off"] + i))
+            holder["state"], holder["off"] = s, holder["off"] + TRAIN_STEPS
+            return loss
+
+        # per-step floor: forward + backward ≥ 3 forward-equivalents (remat
+        # recompute adds more; 3× is the conservative bound)
+        loss_tr, tune_s, bad = measure_with_floor(
+            tune_loop,
+            [None, None],
+            TRAIN_STEPS * 3 * F * FLOPS_PER_FRAME_FWD / peak,
+            "tune steps",
         )
+        if bad:
+            suspect.append("tune_step_ms")
+        breakdown["tune_step_ms"] = round(tune_s / TRAIN_STEPS * 1e3, 1)
+        # divide by the raw reading: the rounded dict entry is 0.0 exactly in
+        # the degraded-measurement case the suspect flag exists to survive
+        breakdown["tune_step_vs_t4"] = round(4.0 * TRAIN_STEPS / max(tune_s, 1e-9), 1)
         assert bool(jnp.isfinite(loss_tr)), "non-finite train loss"
-        del state
+        del state, holder
         jax.clear_caches()
+
+        # Long-video working point (BASELINE configs 3/5: tiger-forest is
+        # 24 frames; the 32-frame edit is the v5e-8 case): 24-frame fast edit
+        # on ONE chip. Dense frame attention cannot run here — the 64²-site
+        # scores alone are 3·24·8·4096² bf16 ≈ 19 GB > HBM — so this measures
+        # the query-chunked kernel (ops/attention.py), the same memory-bounded
+        # path a single chip of the sharded long-video mesh runs.
+        F_LONG = 24
+        wl = build_fast_edit_working_point(
+            num_frames=F_LONG, num_steps=STEPS, frame_attention="chunked"
+        )
+        jax.block_until_ready(wl.edit(wl.params, wl.invert(wl.params, wl.x_warm)[-1]))
+        out_long, long_s, bad = measure_with_floor(
+            lambda x: wl.edit(wl.params, wl.invert(wl.params, x)[-1]),
+            [wl.x0, wl.x0 + 0.001],  # value-fresh per attempt
+            4 * F_LONG * STEPS * FLOPS_PER_FRAME_FWD / peak,  # 1+3 streams
+            "long24",
+        )
+        if bad:
+            suspect.append("long24_fast_edit_e2e_s")
+        assert bool(jnp.isfinite(out_long.astype(jnp.float32)).all())
+        breakdown["long24_fast_edit_e2e_s"] = round(long_s, 3)
+        breakdown["long24_frames_per_sec"] = round(F_LONG / long_s, 3)
+        del out_long, wl
+        jax.clear_caches()
+
+        if suspect:
+            # phases whose every reading stayed below the MFU=1 floor — the
+            # recorded value is the max observed, NOT a trusted measurement
+            breakdown["suspect_measurements"] = suspect
 
         # extended metrics: stderr (stdout stays one JSON line) + a details
         # file next to the repo for the record

@@ -199,6 +199,64 @@ def test_controlled_edit_end_to_end(sched, tiny):
     assert not np.allclose(np.asarray(out_ctrl[1]), np.asarray(out_free[1]), atol=1e-4)
 
 
+def test_long_video_chunked_controlled_edit(sched):
+    """The long-video working point at tiny scale (BASELINE configs 3/5 —
+    24 frames; bench.py's long24 phase): invert + controlled edit with the
+    query-chunked frame-attention kernel, which is the only memory-feasible
+    kernel at 24 frames on one chip (dense 64²-site scores are ~19 GB).
+    Chunked must agree with dense at identical params, and the blend carry /
+    temporal control must shape-generalize past the 8-frame default.
+
+    The dispatch rule falls back to dense below 1024 tokens, so at the tiny
+    UNet's 64-token sites the kernel is forced in directly with a small
+    q_chunk — otherwise this would compare dense against itself."""
+    import functools
+
+    from videop2p_tpu.ops.attention import chunked_frame_attention
+
+    F_LONG = 24
+    cfg = UNet3DConfig.tiny()
+    model = UNet3DConditionModel(
+        config=cfg,
+        frame_attention_fn=functools.partial(chunked_frame_attention, q_chunk=16),
+    )
+    shape = (1, F_LONG, 8, 8, 4)
+    x0 = jax.random.normal(jax.random.key(0), shape)
+    cond = jax.random.normal(jax.random.key(1), (2, 77, cfg.cross_attention_dim))
+    uncond = jnp.zeros((77, cfg.cross_attention_dim))
+    params = jax.jit(model.init)(jax.random.key(2), x0, jnp.asarray(10), cond[:1])
+    fn = make_unet_fn(model)
+    ctx = make_controller(
+        ["a rabbit is jumping", "a origami rabbit is jumping"],
+        WordTokenizer(), num_steps=3,
+        is_replace_controller=False,
+        cross_replace_steps=0.8, self_replace_steps=0.6,
+        blend_words=(["rabbit"], ["rabbit"]),
+        equalizer_params={"words": ["origami"], "values": [2.0]},
+    )
+
+    def run(fn_):
+        traj = ddim_inversion(fn_, params, sched, x0, cond[:1],
+                              num_inference_steps=3)
+        return edit_sample(
+            fn_, params, sched, traj[-1], cond, uncond,
+            num_inference_steps=3, ctx=ctx, source_uses_cfg=False,
+            blend_res=(4, 4),
+        )
+
+    out = jax.jit(lambda: run(fn))()
+    assert out.shape == (2,) + shape[1:]
+    assert np.isfinite(np.asarray(out)).all()
+
+    # kernel equivalence at the same params: chunked == dense (exact math;
+    # the tolerance covers reduce-order fp drift amplified over the scan)
+    model_dense = UNet3DConditionModel(config=UNet3DConfig.tiny())
+    out_dense = jax.jit(lambda: run(make_unet_fn(model_dense)))()
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(out_dense), atol=2e-3, rtol=1e-3
+    )
+
+
 def test_eta_dependent_noise_path(sched):
     """η>0 with the dependent sampler draws frame-correlated variance noise
     (dependent_ddim.py:320-334) — adjacent-frame noise correlation must be

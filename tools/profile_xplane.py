@@ -1,11 +1,10 @@
 """Op-family breakdown of the jitted fast-edit phases on the real chip.
 
-Runs the 50-step inversion + controlled edit under ``jax.profiler.trace`` and
-sums per-op device time from the raw ``*.xplane.pb`` (the tensorboard-plugin
-converter is broken in this image; parse the proto directly with the pure-
-Python protobuf implementation). Inputs are seeded from runtime entropy so the
-axon tunnel's server-side (executable, args) memoization cannot fake a cached
-run (see .claude/skills/verify/SKILL.md).
+Runs the 50-step inversion + controlled edit (the exact bench working point —
+shared via ``bench.build_fast_edit_working_point``) under ``jax.profiler.trace``
+and sums per-op device time from the raw ``*.xplane.pb`` (the tensorboard-
+plugin converter is broken in this image; parse the proto directly with the
+pure-Python protobuf implementation).
 
 Usage:  PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python python tools/profile_xplane.py
 """
@@ -18,12 +17,30 @@ import os
 import re
 import sys
 import tempfile
-import time
 
 os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
 
-import jax
-import jax.numpy as jnp
+
+def iter_device_events(trace_dir: str):
+    """Yield ``(op_name, duration_ps)`` for every "XLA Ops" line event on a
+    device plane of every xplane proto under ``trace_dir``."""
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    for path in glob.glob(
+        os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True
+    ):
+        xspace = xplane_pb2.XSpace()
+        with open(path, "rb") as f:
+            xspace.ParseFromString(f.read())
+        for plane in xspace.planes:
+            if "TPU" not in plane.name and "/device" not in plane.name.lower():
+                continue
+            ev_names = {k: v.name for k, v in plane.event_metadata.items()}
+            for line in plane.lines:
+                if line.name != "XLA Ops":
+                    continue
+                for ev in line.events:
+                    yield ev_names.get(ev.metadata_id, "?"), ev.duration_ps
 
 
 def _op_family(name: str) -> str:
@@ -41,80 +58,32 @@ def _op_family(name: str) -> str:
 
 
 def collect(trace_dir: str) -> dict:
-    from tensorflow.tsl.profiler.protobuf import xplane_pb2
-
     fams = collections.Counter()
     total_ps = 0
-    for path in glob.glob(
-        os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True
-    ):
-        xspace = xplane_pb2.XSpace()
-        with open(path, "rb") as f:
-            xspace.ParseFromString(f.read())
-        for plane in xspace.planes:
-            if "TPU" not in plane.name and "/device" not in plane.name.lower():
-                continue
-            ev_names = {k: v.name for k, v in plane.event_metadata.items()}
-            for line in plane.lines:
-                if line.name != "XLA Ops":
-                    continue
-                for ev in line.events:
-                    name = ev_names.get(ev.metadata_id, "?")
-                    fams[_op_family(name)] += ev.duration_ps
-                    total_ps += ev.duration_ps
+    for name, ps in iter_device_events(trace_dir):
+        fams[_op_family(name)] += ps
+        total_ps += ps
     return {"families": fams, "total_ps": total_ps}
 
 
 def main() -> None:
-    from videop2p_tpu.control import make_controller
-    from videop2p_tpu.core import DDIMScheduler
-    from videop2p_tpu.models import UNet3DConditionModel, UNet3DConfig
-    from videop2p_tpu.pipelines import ddim_inversion, edit_sample, make_unet_fn
-    from videop2p_tpu.utils.tokenizers import WordTokenizer
+    # jax only here: iter_device_events stays import-light for the
+    # proto-parsing CLIs that share it (xplane_top_ops.py)
+    import jax
 
-    cfg = UNet3DConfig.sd15()
-    model = UNet3DConditionModel(config=cfg, dtype=jnp.bfloat16)
-    F, STEPS = 8, 50
-    base = jax.random.key(time.time_ns() % (2**31))
-    k0, k1, k2, k7 = jax.random.split(base, 4)
-    x0 = jax.random.normal(k0, (1, F, 64, 64, 4), jnp.bfloat16)
-    cond = jax.random.normal(k1, (2, 77, 768), jnp.bfloat16)
-    uncond = jnp.zeros((77, 768), jnp.bfloat16)
-    params = jax.jit(model.init)(k2, x0, jnp.asarray(10), cond[:1])
-    params = jax.tree.map(lambda x: x.astype(jnp.bfloat16), params)
-    fn = make_unet_fn(model)
-    sched = DDIMScheduler.create_sd()
-    ctx = make_controller(
-        ["a rabbit is jumping on the grass",
-         "a origami rabbit is jumping on the grass"],
-        WordTokenizer(),
-        num_steps=STEPS,
-        is_replace_controller=False,
-        cross_replace_steps=0.2,
-        self_replace_steps=0.5,
-        blend_words=(["rabbit"], ["rabbit"]),
-        equalizer_params={"words": ["origami"], "values": [2.0]},
-    )
-    invert = jax.jit(
-        lambda p, x: ddim_inversion(fn, p, sched, x, cond[:1],
-                                    num_inference_steps=STEPS)
-    )
-    edit = jax.jit(
-        lambda p, xt: edit_sample(
-            fn, p, sched, xt, cond, uncond,
-            num_inference_steps=STEPS, ctx=ctx, source_uses_cfg=False,
-        )
-    )
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from bench import build_fast_edit_working_point
+
+    wp = build_fast_edit_working_point()
     # compile + warm on a different input (memoization defeat)
-    x_warm = jax.random.normal(k7, x0.shape, x0.dtype)
-    jax.block_until_ready(edit(params, invert(params, x_warm)[-1]))
+    jax.block_until_ready(wp.edit(wp.params, wp.invert(wp.params, wp.x_warm)[-1]))
 
     trace_dir = sys.argv[1] if len(sys.argv) > 1 else tempfile.mkdtemp(
         prefix="videop2p_xplane_"
     )
     with jax.profiler.trace(trace_dir):
-        traj = invert(params, x0)
-        out = edit(params, traj[-1])
+        traj = wp.invert(wp.params, wp.x0)
+        out = wp.edit(wp.params, traj[-1])
         jax.block_until_ready(out)
 
     res = collect(trace_dir)

@@ -1,37 +1,25 @@
 """Top individual XLA ops by device time from an xplane trace dir (see
-profile_xplane.py, which writes the trace). Helps attribute convert/copy time
-to specific tensors before optimizing."""
+profile_xplane.py, which writes the trace and owns the proto walk). Helps
+attribute convert/copy time to specific tensors before optimizing."""
 
 from __future__ import annotations
 
 import collections
-import glob
 import os
 import sys
 
 os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from profile_xplane import iter_device_events  # noqa: E402
+
 
 def main(trace_dir: str, top: int = 40) -> None:
-    from tensorflow.tsl.profiler.protobuf import xplane_pb2
-
     ops = collections.Counter()
     counts = collections.Counter()
-    for path in glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True):
-        xspace = xplane_pb2.XSpace()
-        with open(path, "rb") as f:
-            xspace.ParseFromString(f.read())
-        for plane in xspace.planes:
-            if "TPU" not in plane.name and "/device" not in plane.name.lower():
-                continue
-            ev_names = {k: v.name for k, v in plane.event_metadata.items()}
-            for line in plane.lines:
-                if line.name != "XLA Ops":
-                    continue
-                for ev in line.events:
-                    name = ev_names.get(ev.metadata_id, "?")
-                    ops[name] += ev.duration_ps
-                    counts[name] += 1
+    for name, ps in iter_device_events(trace_dir):
+        ops[name] += ps
+        counts[name] += 1
     total = sum(ops.values())
     print(f"total device op time: {total/1e12:.3f} s ({len(ops)} distinct ops)")
     for name, ps in ops.most_common(top):
