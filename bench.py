@@ -249,152 +249,205 @@ def main() -> None:
     )
 
     if os.environ.get("VIDEOP2P_BENCH_FAST_ONLY", "0") != "1":
-        # Stage-1 tuning step at the reference working point (8 frames, 64²
-        # latents, masked AdamW on the attention projections, per-block
-        # remat): the reference does 300 steps in ~20 min on a T4
-        # (gradio_utils/app_training.py:86) ≈ 4 s/step
-        from videop2p_tpu.core import DDPMScheduler
-        from videop2p_tpu.train import TrainState, TuneConfig, make_optimizer, train_step
+        # Any extended-phase failure (OOM, tunnel flake) must not cost the
+        # round its primary record: partial breakdown still gets written.
+        try:
+            # Stage-1 tuning step at the reference working point (8 frames, 64²
+            # latents, masked AdamW on the attention projections, per-block
+            # remat): the reference does 300 steps in ~20 min on a T4
+            # (gradio_utils/app_training.py:86) ≈ 4 s/step
+            from videop2p_tpu.core import DDPMScheduler
+            from videop2p_tpu.train import TrainState, TuneConfig, make_optimizer, train_step
 
-        # warm inversion input for the null phase — plus a spare trajectory
-        # as the value-fresh retry input for the floor check — while the
-        # inversion executable is still loaded, then drop the fast-phase
-        # programs: each later phase needs the chip's HBM close to free
-        warm_traj = jax.block_until_ready(invert(params, x_warm))
-        x_extra = jax.random.normal(jax.random.fold_in(base, 55), x0.shape, x0.dtype)
-        traj_extra = jax.block_until_ready(invert(params, x_extra))
-        traj_last, warm_last = traj[-1], warm_traj[-1]
-        del out
-        jax.clear_caches()
+            # warm inversion input for the null phase — plus a spare trajectory
+            # as the value-fresh retry input for the floor check — while the
+            # inversion executable is still loaded, then drop the fast-phase
+            # programs: each later phase needs the chip's HBM close to free
+            warm_traj = jax.block_until_ready(invert(params, x_warm))
+            x_extra = jax.random.normal(jax.random.fold_in(base, 55), x0.shape, x0.dtype)
+            traj_extra = jax.block_until_ready(invert(params, x_extra))
+            traj_last, warm_last = traj[-1], warm_traj[-1]
+            del out
+            jax.clear_caches()
 
-        # null-text inversion: 50 outer steps × ≤10 inner Adam steps on the
-        # uncond embedding (run_videop2p.py:580-612) — the official mode's
-        # dominant cost and the declared metric of record (BASELINE.json)
-        # chunked outer scan: the full 50-step program is one multi-minute
-        # device call, which the TPU runtime's execution watchdog kills
-        def null_opt(p, tr):
-            return null_text_optimization(
-                fn_remat, p, sched, tr, cond[:1], uncond[None],
-                num_inference_steps=STEPS, guidance_scale=7.5, outer_chunk=10,
+            # null-text inversion: 50 outer steps × ≤10 inner Adam steps on the
+            # uncond embedding (run_videop2p.py:580-612) — the official mode's
+            # dominant cost and the declared metric of record (BASELINE.json)
+            # chunked outer scan: the full 50-step program is one multi-minute
+            # device call, which the TPU runtime's execution watchdog kills
+            def null_opt(p, tr):
+                return null_text_optimization(
+                    fn_remat, p, sched, tr, cond[:1], uncond[None],
+                    num_inference_steps=STEPS, guidance_scale=7.5, outer_chunk=10,
+                )
+            edit_official = jax.jit(
+                lambda p, xt, ns: edit_sample(
+                    fn, p, sched, xt, cond, uncond,
+                    num_inference_steps=STEPS, ctx=ctx, source_uses_cfg=True,
+                    null_uncond_embeddings=ns,
+                )
             )
-        edit_official = jax.jit(
-            lambda p, xt, ns: edit_sample(
-                fn, p, sched, xt, cond, uncond,
-                num_inference_steps=STEPS, ctx=ctx, source_uses_cfg=True,
-                null_uncond_embeddings=ns,
+            warm_null = jax.block_until_ready(null_opt(params, warm_traj))
+            # floor: even if every inner Adam loop early-stops at 0 iterations,
+            # each of the 50 outer steps runs 2 forwards (cond + final uncond)
+            null_seq, null_s, bad = measure_with_floor(
+                lambda tr: null_opt(params, tr),
+                [traj, traj_extra],
+                2 * STEPS * F * FLOPS_PER_FRAME_FWD / peak,
+                "null-text",
             )
-        )
-        warm_null = jax.block_until_ready(null_opt(params, warm_traj))
-        # floor: even if every inner Adam loop early-stops at 0 iterations,
-        # each of the 50 outer steps runs 2 forwards (cond + final uncond)
-        null_seq, null_s, bad = measure_with_floor(
-            lambda tr: null_opt(params, tr),
-            [traj, traj_extra],
-            2 * STEPS * F * FLOPS_PER_FRAME_FWD / peak,
-            "null-text",
-        )
-        if bad:
-            suspect.append("null_text_wall_s")
-        del traj, warm_traj, traj_extra
-        jax.clear_caches()
+            if bad:
+                suspect.append("null_text_wall_s")
+            del traj, warm_traj, traj_extra
+            jax.clear_caches()
 
-        jax.block_until_ready(edit_official(params, warm_last, warm_null))
-        out_off, edit_off_s, bad = measure_with_floor(
-            lambda xt: edit_official(params, xt, null_seq),
-            [traj_last, warm_last + 0.001],  # value-fresh x_T per attempt
-            4 * F * STEPS * FLOPS_PER_FRAME_FWD / peak,  # full CFG: 4 streams
-            "official edit",
-        )
-        if bad:
-            suspect.append("official_edit_s")
-        breakdown["null_text_wall_s"] = round(null_s, 3)
-        official = inv_s + null_s + edit_off_s
-        breakdown["official_edit_s"] = round(edit_off_s, 3)
-        breakdown["official_edit_e2e_s"] = round(official, 3)
-        breakdown["official_vs_baseline"] = round(V100_OFFICIAL_EDIT_S / official, 2)
+            jax.block_until_ready(edit_official(params, warm_last, warm_null))
+            out_off, edit_off_s, bad = measure_with_floor(
+                lambda xt: edit_official(params, xt, null_seq),
+                [traj_last, warm_last + 0.001],  # value-fresh x_T per attempt
+                4 * F * STEPS * FLOPS_PER_FRAME_FWD / peak,  # full CFG: 4 streams
+                "official edit",
+            )
+            if bad:
+                suspect.append("official_edit_s")
+            breakdown["null_text_wall_s"] = round(null_s, 3)
+            official = inv_s + null_s + edit_off_s
+            breakdown["official_edit_s"] = round(edit_off_s, 3)
+            breakdown["official_edit_e2e_s"] = round(official, 3)
+            breakdown["official_vs_baseline"] = round(V100_OFFICIAL_EDIT_S / official, 2)
 
-        # Stage-1 tuning step, measured LAST on a cleared chip (its grad
-        # program + optimizer state need the HBM to themselves)
-        del out_off, null_seq, warm_null
-        jax.clear_caches()
-        tune_cfg = TuneConfig()
-        tx = make_optimizer(tune_cfg)
-        # the real Stage-1 configuration: per-block remat AND the chunked
-        # frame-attention kernel — a dense N² attention backward OOMs
-        # (cli/run_tuning.py builds the same)
-        model_train = UNet3DConditionModel(
-            config=UNet3DConfig.sd15(
-                gradient_checkpointing=True, frame_attention="chunked"
-            ),
-            dtype=jnp.bfloat16,
-        )
-        fn_r = make_unet_fn(model_train)
-        state = TrainState.create(
-            {k: v for k, v in params["params"].items()}, tx,
-            tune_cfg.trainable_modules,
-        )
-        ddpm = DDPMScheduler.create_sd()
-        k3, k4, k5 = jax.random.split(jax.random.fold_in(base, 99), 3)
-        lat_train = jax.random.normal(k3, (1, F, 64, 64, 4))
-        step = jax.jit(
-            lambda s, k: train_step(fn_r, tx, s, ddpm, lat_train, cond[:1], k)
-        )
-        state, _ = step(state, k4)  # compile + step 1
-        jax.block_until_ready(state.trainable)
-        TRAIN_STEPS = 5
-        holder = {"state": state, "off": 0}
+            # Stage-1 tuning step, measured LAST on a cleared chip (its grad
+            # program + optimizer state need the HBM to themselves)
+            del out_off, null_seq, warm_null
+            jax.clear_caches()
+            tune_cfg = TuneConfig()
+            tx = make_optimizer(tune_cfg)
+            # the real Stage-1 configuration: per-block remat AND the chunked
+            # frame-attention kernel — a dense N² attention backward OOMs
+            # (cli/run_tuning.py builds the same)
+            model_train = UNet3DConditionModel(
+                config=UNet3DConfig.sd15(
+                    gradient_checkpointing=True, frame_attention="chunked"
+                ),
+                dtype=jnp.bfloat16,
+            )
+            fn_r = make_unet_fn(model_train)
+            state = TrainState.create(
+                {k: v for k, v in params["params"].items()}, tx,
+                tune_cfg.trainable_modules,
+            )
+            ddpm = DDPMScheduler.create_sd()
+            k3, k4, k5 = jax.random.split(jax.random.fold_in(base, 99), 3)
+            lat_train = jax.random.normal(k3, (1, F, 64, 64, 4))
+            step = jax.jit(
+                lambda s, k: train_step(fn_r, tx, s, ddpm, lat_train, cond[:1], k)
+            )
+            state, _ = step(state, k4)  # compile + step 1
+            jax.block_until_ready(state.trainable)
+            TRAIN_STEPS = 5
+            holder = {"state": state, "off": 0}
 
-        def tune_loop(_):
-            s = holder["state"]
-            for i in range(TRAIN_STEPS):
-                # the evolving state + per-attempt key offset keep every
-                # step's args value-fresh across retries
-                s, loss = step(s, jax.random.fold_in(k5, holder["off"] + i))
-            holder["state"], holder["off"] = s, holder["off"] + TRAIN_STEPS
-            return loss
+            def tune_loop(_):
+                s = holder["state"]
+                for i in range(TRAIN_STEPS):
+                    # the evolving state + per-attempt key offset keep every
+                    # step's args value-fresh across retries
+                    s, loss = step(s, jax.random.fold_in(k5, holder["off"] + i))
+                holder["state"], holder["off"] = s, holder["off"] + TRAIN_STEPS
+                return loss
 
-        # per-step floor: forward + backward ≥ 3 forward-equivalents (remat
-        # recompute adds more; 3× is the conservative bound)
-        loss_tr, tune_s, bad = measure_with_floor(
-            tune_loop,
-            [None, None],
-            TRAIN_STEPS * 3 * F * FLOPS_PER_FRAME_FWD / peak,
-            "tune steps",
-        )
-        if bad:
-            suspect.append("tune_step_ms")
-        breakdown["tune_step_ms"] = round(tune_s / TRAIN_STEPS * 1e3, 1)
-        # divide by the raw reading: the rounded dict entry is 0.0 exactly in
-        # the degraded-measurement case the suspect flag exists to survive
-        breakdown["tune_step_vs_t4"] = round(4.0 * TRAIN_STEPS / max(tune_s, 1e-9), 1)
-        assert bool(jnp.isfinite(loss_tr)), "non-finite train loss"
-        del state, holder
-        jax.clear_caches()
+            # per-step floor: forward + backward ≥ 3 forward-equivalents (remat
+            # recompute adds more; 3× is the conservative bound)
+            loss_tr, tune_s, bad = measure_with_floor(
+                tune_loop,
+                [None, None],
+                TRAIN_STEPS * 3 * F * FLOPS_PER_FRAME_FWD / peak,
+                "tune steps",
+            )
+            if bad:
+                suspect.append("tune_step_ms")
+            breakdown["tune_step_ms"] = round(tune_s / TRAIN_STEPS * 1e3, 1)
+            # divide by the raw reading: the rounded dict entry is 0.0 exactly in
+            # the degraded-measurement case the suspect flag exists to survive
+            breakdown["tune_step_vs_t4"] = round(4.0 * TRAIN_STEPS / max(tune_s, 1e-9), 1)
+            assert bool(jnp.isfinite(loss_tr)), "non-finite train loss"
+            del state, holder
+            jax.clear_caches()
 
-        # Long-video working point (BASELINE configs 3/5: tiger-forest is
-        # 24 frames; the 32-frame edit is the v5e-8 case): 24-frame fast edit
-        # on ONE chip. Dense frame attention cannot run here — the 64²-site
-        # scores alone are 3·24·8·4096² bf16 ≈ 19 GB > HBM — so this measures
-        # the query-chunked kernel (ops/attention.py), the same memory-bounded
-        # path a single chip of the sharded long-video mesh runs.
-        F_LONG = 24
-        wl = build_fast_edit_working_point(
-            num_frames=F_LONG, num_steps=STEPS, frame_attention="chunked"
-        )
-        jax.block_until_ready(wl.edit(wl.params, wl.invert(wl.params, wl.x_warm)[-1]))
-        out_long, long_s, bad = measure_with_floor(
-            lambda x: wl.edit(wl.params, wl.invert(wl.params, x)[-1]),
-            [wl.x0, wl.x0 + 0.001],  # value-fresh per attempt
-            4 * F_LONG * STEPS * FLOPS_PER_FRAME_FWD / peak,  # 1+3 streams
-            "long24",
-        )
-        if bad:
-            suspect.append("long24_fast_edit_e2e_s")
-        assert bool(jnp.isfinite(out_long.astype(jnp.float32)).all())
-        breakdown["long24_fast_edit_e2e_s"] = round(long_s, 3)
-        breakdown["long24_frames_per_sec"] = round(F_LONG / long_s, 3)
-        del out_long, wl
-        jax.clear_caches()
+            # Long-video working point (BASELINE configs 3/5: tiger-forest is
+            # 24 frames; the 32-frame edit is the v5e-8 case): 24-frame fast edit
+            # on ONE chip. Dense frame attention cannot run here — the 64²-site
+            # scores alone are 3·24·8·4096² bf16 ≈ 19 GB > HBM — so this measures
+            # the query-chunked kernel (ops/attention.py), the same memory-bounded
+            # path a single chip of the sharded long-video mesh runs.
+            F_LONG = 24
+            wl = build_fast_edit_working_point(
+                num_frames=F_LONG, num_steps=STEPS, frame_attention="chunked"
+            )
+            jax.block_until_ready(wl.edit(wl.params, wl.invert(wl.params, wl.x_warm)[-1]))
+            out_long, long_s, bad = measure_with_floor(
+                lambda x: wl.edit(wl.params, wl.invert(wl.params, x)[-1]),
+                [wl.x0, wl.x0 + 0.001],  # value-fresh per attempt
+                4 * F_LONG * STEPS * FLOPS_PER_FRAME_FWD / peak,  # 1+3 streams
+                "long24",
+            )
+            if bad:
+                suspect.append("long24_fast_edit_e2e_s")
+            assert bool(jnp.isfinite(out_long.astype(jnp.float32)).all())
+            breakdown["long24_fast_edit_e2e_s"] = round(long_s, 3)
+            breakdown["long24_frames_per_sec"] = round(F_LONG / long_s, 3)
+            del out_long, wl
+            jax.clear_caches()
+
+            # SDXL-shaped inflation stress (BASELINE config 4): one denoiser
+            # forward at 8 frames × 128² latents (1024² pixels), 2048-dim
+            # text context, ~3B params — fits one chip in bf16 only if the
+            # f32 init is cast with buffer DONATION (f32 + bf16 trees
+            # together are ~18 GB) and frame attention is query-chunked
+            # (dense 64²-site scores at 10 heads are ~2.7 GB per stream).
+            from videop2p_tpu.models import UNet3DConditionModel, UNet3DConfig
+            from videop2p_tpu.pipelines import make_unet_fn
+
+            sx_model = UNet3DConditionModel(
+                config=UNet3DConfig.sdxl(frame_attention="chunked"),
+                dtype=jnp.bfloat16,
+            )
+            ks0, ks1, ks2, ks3 = jax.random.split(jax.random.fold_in(base, 77), 4)
+            sx = jax.random.normal(ks0, (1, F, 128, 128, 4), jnp.bfloat16)
+            sx_txt = jax.random.normal(ks1, (1, 77, 2048), jnp.bfloat16)
+            sx_params = jax.jit(sx_model.init)(ks2, sx[:, :2], jnp.asarray(10), sx_txt)
+            cast = jax.jit(
+                lambda p: jax.tree.map(lambda a: a.astype(jnp.bfloat16), p),
+                donate_argnums=0,
+            )
+            sx_params = cast(sx_params)
+            sx_fn = make_unet_fn(sx_model)
+            sx_fwd = jax.jit(lambda p, s: sx_fn(p, s, jnp.asarray(500), sx_txt)[0])
+            jax.block_until_ready(
+                sx_fwd(sx_params, jax.random.normal(ks3, sx.shape, sx.dtype))
+            )
+            # floor from a safe FLOP lower bound: SDXL-base 2-D is ~2.6 TF
+            # per image at 128² latents, and the 3-D variant adds frame +
+            # temporal attention on top — so ≥ 2.6 TF/frame-forward
+            sx_out, sx_s, bad = measure_with_floor(
+                lambda s: sx_fwd(sx_params, s),
+                [sx, sx + 0.001],
+                8 * 2.6e12 / peak,
+                "sdxl forward",
+            )
+            if bad:
+                suspect.append("sdxl_fwd_ms")
+            assert bool(jnp.isfinite(sx_out.astype(jnp.float32)).all())
+            breakdown["sdxl_fwd_ms"] = round(sx_s * 1e3, 0)
+            breakdown["sdxl_params_b"] = round(
+                sum(a.size for a in jax.tree.leaves(sx_params)) / 1e9, 2
+            )
+            del sx_out, sx_params
+            jax.clear_caches()
+
+        except Exception as e:  # noqa: BLE001 — record, don't die
+            breakdown["extended_error"] = f"{type(e).__name__}: {e}"[:300]
+            print(f"[bench] extended phase failed: {e}", file=sys.stderr, flush=True)
 
         if suspect:
             # phases whose every reading stayed below the MFU=1 floor — the
