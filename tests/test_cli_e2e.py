@@ -70,6 +70,122 @@ def test_stage2_fast_edit_with_blend(tuned_dir):
     assert tuned_dir in edit_gif  # results land inside the suffixed dir
 
 
+@pytest.fixture(scope="module")
+def source_pipeline_dir(tmp_path_factory):
+    """A tiny diffusers-layout SD checkpoint WITH vae and text_encoder
+    weights — the real Stage-1 input shape (run_tuning.py:126-131 loads all
+    components from ``pretrained_model_path``), as opposed to the weightless
+    smoke path the other fixtures drive."""
+    import json
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import torch
+    from safetensors.numpy import save_file
+    from transformers import CLIPTextConfig as HFConfig, CLIPTextModel
+
+    from tests.torch_ref import TorchVAE
+    from videop2p_tpu.models import UNet3DConditionModel, UNet3DConfig, VAEConfig
+    from videop2p_tpu.models.pipeline_io import save_pipeline
+
+    root = str(tmp_path_factory.mktemp("source_ckpt") / "sd-tiny")
+    ucfg = UNet3DConfig.tiny()
+    unet = UNet3DConditionModel(config=ucfg)
+    uparams = unet.init(
+        jax.random.key(0),
+        jnp.zeros((1, 2, 8, 8, 4)),
+        jnp.asarray(0),
+        jnp.zeros((1, 77, ucfg.cross_attention_dim)),
+    )
+    save_pipeline(
+        root, ucfg, uparams,
+        scheduler_config={
+            "num_train_timesteps": 1000, "beta_start": 0.00085,
+            "beta_end": 0.012, "beta_schedule": "scaled_linear",
+            "clip_sample": False, "set_alpha_to_one": False, "steps_offset": 1,
+        },
+    )
+
+    vcfg = VAEConfig.tiny()
+    torch.manual_seed(0)
+    tvae = TorchVAE(vcfg).eval()
+    os.makedirs(os.path.join(root, "vae"))
+    save_file(
+        {k: v.detach().numpy() for k, v in tvae.state_dict().items()},
+        os.path.join(root, "vae", "diffusion_pytorch_model.safetensors"),
+    )
+    with open(os.path.join(root, "vae", "config.json"), "w") as f:
+        json.dump({
+            "in_channels": vcfg.in_channels, "out_channels": vcfg.out_channels,
+            "latent_channels": vcfg.latent_channels,
+            "block_out_channels": list(vcfg.block_out_channels),
+            "layers_per_block": vcfg.layers_per_block,
+            "norm_num_groups": vcfg.norm_num_groups,
+            "scaling_factor": vcfg.scaling_factor,
+        }, f)
+
+    hf_cfg = HFConfig(
+        vocab_size=128, hidden_size=ucfg.cross_attention_dim,
+        intermediate_size=32, num_hidden_layers=2, num_attention_heads=2,
+        max_position_embeddings=77, hidden_act="quick_gelu",
+    )
+    te = CLIPTextModel(hf_cfg).eval()
+    os.makedirs(os.path.join(root, "text_encoder"))
+    save_file(
+        {k: v.detach().numpy() for k, v in te.state_dict().items()},
+        os.path.join(root, "text_encoder", "model.safetensors"),
+    )
+    with open(os.path.join(root, "text_encoder", "config.json"), "w") as f:
+        json.dump({
+            "vocab_size": 128, "hidden_size": ucfg.cross_attention_dim,
+            "intermediate_size": 32, "num_hidden_layers": 2,
+            "num_attention_heads": 2, "max_position_embeddings": 77,
+        }, f)
+    return root
+
+
+def test_two_stage_real_weights_no_backfill(source_pipeline_dir, tmp_path):
+    """The NON-degraded export contract (VERDICT r2 item 7): Stage 1 starts
+    from a checkpoint with real vae/text_encoder weights, copies them through
+    to its export (run_tuning.py:387-393 semantics), and Stage 2 loads that
+    export WITHOUT the RANDOM-INIT backfill warning."""
+    import warnings
+
+    from videop2p_tpu.cli.run_tuning import main as tune
+    from videop2p_tpu.cli.run_videop2p import main as p2p
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", UserWarning)  # backfill warning = fail
+        out = tune(
+            pretrained_model_path=source_pipeline_dir,
+            output_dir=str(tmp_path / "exp"),
+            train_data={
+                "video_path": "data/rabbit", "prompt": "a rabbit is jumping",
+                "n_sample_frames": 2, "width": 16, "height": 16,
+            },
+            validation_data={
+                "prompts": ["a origami rabbit"], "num_inference_steps": 2,
+                "num_inv_steps": 2, "guidance_scale": 7.5, "use_inv_latent": True,
+            },
+            max_train_steps=2, validation_steps=2, checkpointing_steps=2,
+            mixed_precision="no", log_every=1,
+        )
+        # the export carries the frozen components, not just the UNet
+        for sub in ("vae", "text_encoder", "unet", "scheduler"):
+            assert os.path.isdir(os.path.join(out, sub)), sub
+
+        inv_gif, edit_gif = p2p(
+            pretrained_model_path=out,
+            image_path="data/rabbit",
+            prompt="a rabbit is jumping",
+            prompts=["a rabbit is jumping", "a origami rabbit is jumping"],
+            save_name="origami", is_word_swap=False,
+            video_len=2, width=16, fast=True,
+        )
+    assert os.path.isfile(inv_gif) and os.path.isfile(edit_gif)
+
+
 def test_stage2_no_blend_path(tuned_dir):
     """bird-forest style edit: refine controller, custom replace ratios, NO
     LocalBlend (configs/bird-forest-p2p.yaml has no blend_word)."""

@@ -1,0 +1,113 @@
+"""Legacy image-helper tests (videop2p_tpu/utils/images.py — the port of
+/root/reference/ptp_utils.py:26-186): grid/annotation compositing contracts
+and the 1-frame controlled text→image path on tiny models."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from videop2p_tpu.utils.images import (
+    init_latent,
+    latent2image,
+    latent2image_video,
+    text2image_stable,
+    text_under_image,
+    view_images,
+)
+
+
+def test_text_under_image_extends_by_fifth():
+    img = np.zeros((50, 40, 3), np.uint8)
+    out = text_under_image(img, "hi")
+    assert out.shape == (60, 40, 3)
+    assert (out[:50] == 0).all()  # original pixels intact
+    assert out.dtype == np.uint8
+
+
+def test_view_images_grid_shape_and_padding(tmp_path):
+    ims = [np.full((10, 10, 3), i, np.uint8) for i in (10, 20, 30)]
+    pil = view_images(ims, num_rows=2, save_path=str(tmp_path / "grid.png"))
+    arr = np.asarray(pil)
+    # 2 rows × 2 cols (one white filler), offset = int(10 * 0.02) = 0
+    assert arr.shape == (20, 20, 3)
+    assert arr[0, 0, 0] == 10 and arr[0, 10, 0] == 20 and arr[10, 0, 0] == 30
+    assert arr[10, 10, 0] == 255  # filler
+    assert (tmp_path / "grid.png").exists()
+
+    single = view_images(np.full((8, 8, 3), 7, np.uint8))
+    assert np.asarray(single).shape == (8, 8, 3)
+
+
+def test_init_latent_expands_shared_xt():
+    key = jax.random.key(0)
+    latent, latents = init_latent(None, 3, height=64, width=64, key=key)
+    assert latent.shape == (1, 8, 8, 4)
+    assert latents.shape == (3, 8, 8, 4)
+    np.testing.assert_array_equal(latents[0], latents[2])
+    # passthrough keeps the provided latent
+    latent2, latents2 = init_latent(latent, 2)
+    assert latent2 is latent and latents2.shape == (2, 8, 8, 4)
+    with pytest.raises(ValueError):
+        init_latent(None, 1)
+
+
+@pytest.fixture(scope="module")
+def tiny_vae():
+    from videop2p_tpu.models.vae import AutoencoderKL, VAEConfig
+
+    model = AutoencoderKL(config=VAEConfig.tiny())
+    x = jnp.zeros((1, 16, 16, 3))
+    params = model.init(jax.random.key(0), x, jax.random.key(1))
+    return model, params
+
+
+def test_latent2image_shapes_and_range(tiny_vae):
+    vae, params = tiny_vae
+    # tiny VAE has 2 resolution levels -> spatial scale factor 2
+    z = 0.1 * jax.random.normal(jax.random.key(2), (2, 8, 8, 4))
+    img = latent2image(vae, params, z)
+    assert img.shape == (2, 16, 16, 3) and img.dtype == np.uint8
+
+    zv = 0.1 * jax.random.normal(jax.random.key(3), (1, 3, 8, 8, 4))
+    frames = latent2image_video(vae, params, zv, chunk=2)
+    assert frames.shape == (3, 16, 16, 3) and frames.dtype == np.uint8
+
+
+@pytest.mark.slow
+def test_text2image_stable_controlled(tiny_vae):
+    from videop2p_tpu.control import make_controller
+    from videop2p_tpu.core import DDIMScheduler
+    from videop2p_tpu.models import UNet3DConditionModel, UNet3DConfig
+    from videop2p_tpu.pipelines import make_unet_fn
+    from videop2p_tpu.utils.tokenizers import WordTokenizer
+
+    cfg = UNet3DConfig.tiny()
+    model = UNet3DConditionModel(config=cfg)
+    x = jnp.zeros((1, 1, 8, 8, 4))
+    cond = jax.random.normal(jax.random.key(4), (2, 77, cfg.cross_attention_dim))
+    params = model.init(jax.random.key(5), x, jnp.asarray(0), cond[:1])
+    ctx = make_controller(
+        ["a cat", "a dog"],
+        WordTokenizer(),
+        num_steps=3,
+        is_replace_controller=True,
+        cross_replace_steps=0.8,
+        self_replace_steps=0.5,
+    )
+    images, latent = text2image_stable(
+        make_unet_fn(model),
+        params,
+        DDIMScheduler.create_sd(),
+        *tiny_vae,
+        cond,
+        jnp.zeros((77, cfg.cross_attention_dim)),
+        ctx=ctx,
+        num_inference_steps=3,
+        height=16,
+        width=16,
+        vae_scale_factor=2,
+        key=jax.random.key(6),
+    )
+    assert images.shape == (2, 16, 16, 3) and images.dtype == np.uint8
+    assert latent.shape == (1, 8, 8, 4)

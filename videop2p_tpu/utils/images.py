@@ -1,0 +1,196 @@
+"""Still-image helpers: P2P's legacy notebook surface, TPU-native.
+
+Re-design of the image-side utilities the reference keeps in
+``/root/reference/ptp_utils.py:26-186`` (``text_under_image``,
+``view_images``, ``latent2image``, ``latent2image_video``, ``init_latent``,
+``diffusion_step``, ``text2image_ldm_stable``): grid/annotation compositing
+is plain numpy + PIL, and text→image sampling is the video pipeline's
+``edit_sample`` scan at a single frame — the controlled CFG loop, scheduler
+step, and LocalBlend callback are shared with the video path instead of the
+reference's separate per-helper Python denoise loop (ptp_utils.py:65-79).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+__all__ = [
+    "text_under_image",
+    "view_images",
+    "latent2image",
+    "latent2image_video",
+    "init_latent",
+    "text2image_stable",
+]
+
+
+def text_under_image(
+    image: np.ndarray,
+    text: str,
+    text_color: Tuple[int, int, int] = (0, 0, 0),
+) -> np.ndarray:
+    """Extend ``image`` (H, W, 3 uint8) downward by 20 % and center ``text``
+    in the new strip (ptp_utils.py:26-35; PIL here instead of cv2)."""
+    from PIL import Image, ImageDraw
+
+    img = np.asarray(image, dtype=np.uint8)
+    h, w, c = img.shape
+    offset = int(h * 0.2)
+    out = np.full((h + offset, w, c), 255, dtype=np.uint8)
+    out[:h] = img
+    pil = Image.fromarray(out)
+    draw = ImageDraw.Draw(pil)
+    left, top, right, bottom = draw.textbbox((0, 0), text)
+    tw, th = right - left, bottom - top
+    draw.text(((w - tw) // 2, h + (offset - th) // 2), text, fill=text_color)
+    return np.asarray(pil)
+
+
+def view_images(
+    images: Union[np.ndarray, Sequence[np.ndarray]],
+    num_rows: int = 1,
+    offset_ratio: float = 0.02,
+    save_path: Optional[str] = None,
+):
+    """Tile images (each H, W, 3 uint8) into a white-padded grid
+    (ptp_utils.py:38-62). Returns the PIL image; saves to ``save_path`` when
+    given and displays inline only under IPython (the reference
+    unconditionally imports IPython — a notebook-only helper; this one also
+    works from scripts)."""
+    from PIL import Image
+
+    if isinstance(images, np.ndarray) and images.ndim == 3:
+        images = [images]
+    images = [np.asarray(im, dtype=np.uint8) for im in images]
+    num_empty = len(images) % num_rows
+    if num_empty:
+        images += [np.full_like(images[0], 255)] * (num_rows - num_empty)
+
+    h, w, _ = images[0].shape
+    offset = int(h * offset_ratio)
+    num_cols = len(images) // num_rows
+    grid = np.full(
+        (h * num_rows + offset * (num_rows - 1),
+         w * num_cols + offset * (num_cols - 1), 3),
+        255,
+        dtype=np.uint8,
+    )
+    for idx, im in enumerate(images):
+        r, c = divmod(idx, num_cols)
+        grid[r * (h + offset): r * (h + offset) + h,
+             c * (w + offset): c * (w + offset) + w] = im
+    pil = Image.fromarray(grid)
+    if save_path is not None:
+        pil.save(save_path)
+    try:  # pragma: no cover - notebook-only path
+        from IPython.display import display
+
+        get_ipython  # noqa: B018 — defined only inside IPython
+        display(pil)
+    except (ImportError, NameError):
+        pass
+    return pil
+
+
+def latent2image(vae, vae_params, latents) -> np.ndarray:
+    """Scaled image latents (B, h, w, 4) → uint8 images (B, 8h, 8w, 3)
+    (ptp_utils.py:81-88: ÷0.18215, decode, [-1,1]→[0,255])."""
+    import jax.numpy as jnp
+
+    from videop2p_tpu.utils.video_io import to_uint8
+
+    z = jnp.asarray(latents) / vae.config.scaling_factor
+    img = vae.apply(vae_params, z, method=vae.decode)
+    return to_uint8(np.asarray(img.astype(jnp.float32)) / 2 + 0.5)
+
+
+def latent2image_video(vae, vae_params, latents, *, chunk: int = 4) -> np.ndarray:
+    """Scaled video latents (1, F, h, w, 4) → uint8 frames (F, 8h, 8w, 3)
+    (ptp_utils.py:90-98, with the pipeline's chunked per-frame decode)."""
+    import jax.numpy as jnp
+
+    from videop2p_tpu.models.vae import decode_video
+    from videop2p_tpu.utils.video_io import to_uint8
+
+    video = decode_video(vae, vae_params, jnp.asarray(latents), chunk=chunk)[0]
+    return to_uint8(np.asarray(video.astype(jnp.float32)) / 2 + 0.5)
+
+
+def init_latent(
+    latent,
+    batch_size: int,
+    *,
+    height: int = 512,
+    width: int = 512,
+    channels: int = 4,
+    vae_scale_factor: int = 8,
+    key=None,
+):
+    """Draw (or pass through) a batch-1 latent and expand it to the prompt
+    batch so every stream shares x_T (ptp_utils.py:101-109; channels-last,
+    and the reference's hard-coded ÷8 generalized to ``vae_scale_factor``).
+    Returns ``(latent, latents)`` like the reference."""
+    import jax
+    import jax.numpy as jnp
+
+    if latent is None:
+        if key is None:
+            raise ValueError("init_latent needs a PRNG key when latent is None")
+        latent = jax.random.normal(
+            key,
+            (1, height // vae_scale_factor, width // vae_scale_factor, channels),
+            jnp.float32,
+        )
+    latents = jnp.broadcast_to(
+        latent, (batch_size,) + tuple(latent.shape[1:])
+    )
+    return latent, latents
+
+
+def text2image_stable(
+    unet_fn,
+    params,
+    scheduler,
+    vae,
+    vae_params,
+    cond_embeddings,
+    uncond_embeddings,
+    *,
+    ctx=None,
+    num_inference_steps: int = 50,
+    guidance_scale: float = 7.5,
+    height: int = 512,
+    width: int = 512,
+    vae_scale_factor: int = 8,
+    latent=None,
+    key=None,
+) -> Tuple[np.ndarray, "np.ndarray"]:
+    """Controlled text→image sampling (ptp_utils.py:142-186) as a 1-frame
+    video: the shared ``edit_sample`` scan runs the CFG denoise with the P2P
+    controller and LocalBlend, then the VAE decodes. ``cond_embeddings``:
+    (P, 77, D) with the source prompt first; returns ``(images, latent)``.
+    """
+    import jax.numpy as jnp
+
+    from videop2p_tpu.pipelines.sampling import edit_sample
+
+    batch = cond_embeddings.shape[0]
+    latent, latents = init_latent(
+        latent, batch, height=height, width=width,
+        vae_scale_factor=vae_scale_factor, key=key,
+    )
+    out = edit_sample(
+        unet_fn,
+        params,
+        scheduler,
+        latents[:, None],  # (P, F=1, h, w, C)
+        jnp.asarray(cond_embeddings),
+        jnp.asarray(uncond_embeddings),
+        num_inference_steps=num_inference_steps,
+        guidance_scale=guidance_scale,
+        ctx=ctx,
+    )
+    images = latent2image(vae, vae_params, out[:, 0])
+    return images, latent
