@@ -51,6 +51,47 @@ def test_chunked_falls_back_on_indivisible():
     )
 
 
+def test_fused_matches_dense_interpret():
+    """The custom Pallas kernel (frame-0-KV resident in VMEM, full-row
+    softmax) must equal dense — run in interpret mode so CPU tests cover the
+    kernel math; the real-TPU path is exercised by bench.py."""
+    from videop2p_tpu.ops import fused_frame_attention
+
+    q, k, v = _rand_qkv(jax.random.key(5), F=2, N=256, D=8)
+    out = jax.jit(
+        lambda q, k, v: fused_frame_attention(q, k, v, 128, True)
+    )(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(dense_frame_attention(q, k, v)), atol=1e-5
+    )
+
+
+def test_fused_grad_falls_back_to_chunked():
+    """Differentiating through the fused kernel must agree with dense — the
+    custom VJP recomputes via the chunked exact backward."""
+    from videop2p_tpu.ops import fused_frame_attention
+
+    q, k, v = _rand_qkv(jax.random.key(6), F=2, N=256, D=4)
+
+    g_f = jax.jit(jax.grad(lambda q: jnp.sum(
+        fused_frame_attention(q, k, v, 128, True) ** 2)))(q)
+    g_d = jax.jit(jax.grad(lambda q: jnp.sum(
+        dense_frame_attention(q, k, v) ** 2)))(q)
+    np.testing.assert_allclose(np.asarray(g_f), np.asarray(g_d), atol=1e-4)
+
+
+def test_auto_dispatch_off_tpu_is_dense():
+    # "auto" resolves per-backend: dense (None) on CPU, fused on TPU
+    assert make_frame_attention_fn("auto") is None
+    # "fused" off-TPU falls back to chunked for large sites (still exact)
+    fn = make_frame_attention_fn("fused", min_large_tokens=1024)
+    q, k, v = _rand_qkv(jax.random.key(7), N=2048, D=4)
+    out = jax.jit(fn)(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(dense_frame_attention(q, k, v)), atol=1e-5
+    )
+
+
 def test_dispatch_rules():
     assert make_frame_attention_fn("dense") is None
     fn = make_frame_attention_fn("chunked", min_large_tokens=1024)

@@ -1,0 +1,127 @@
+"""Frame-attention kernel shootout at the SD-1.5 hot shape.
+
+Times every ops/attention.py implementation (plus head-dim-padded Pallas
+variants) at the 64²-site working point of the fast edit — B=3 streams,
+F=8 frames, H=8 heads, N=4096 tokens, d=40 — the op family that pins the
+edit step at 277 ms (MFU 0.36) in round 2.
+
+Measurement per impl: warm on a fresh input, then time a CHAIN of calls
+where each input depends on the previous output (the axon tunnel memoizes
+repeated identical executions server-side and has acked dispatches early;
+a value-chain defeats both), ending with a device→host value fetch.
+
+Usage: PYTHONPATH=/root/repo python tools/bench_attention.py [reps]
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, "/root/repo")
+
+from videop2p_tpu.ops.attention import (  # noqa: E402
+    chunked_frame_attention,
+    dense_frame_attention,
+    flash_frame_attention,
+    flash_rect_frame_attention,
+    fused_frame_attention,
+)
+
+B, F, H, N, D = 3, 8, 8, 4096, 40
+
+
+def padded(fn, d_pad: int):
+    """Zero-pad the head dim before a kernel: scores are unchanged (extra
+    dims contribute 0 to q·k), V's extra columns are zero — slice them off.
+    Tests whether the Pallas kernel's d→128 tile padding is the loss."""
+
+    def wrapped(q, k, v):
+        pad = [(0, 0)] * (q.ndim - 1) + [(0, d_pad - q.shape[-1])]
+        pad_kv = [(0, 0)] * (k.ndim - 1) + [(0, d_pad - k.shape[-1])]
+        out = fn(
+            jnp.pad(q, pad),
+            jnp.pad(k, pad_kv),
+            jnp.pad(v, pad_kv),
+        )
+        return out[..., : q.shape[-1]]
+
+    return wrapped
+
+
+def scaled_pad(fn, d_pad: int):
+    """Pad variant with exact softmax scale: the kernel scales by
+    d_pad**-0.5, so pre-multiplying q by (d_pad/d)**0.5 restores the true
+    d**-0.5 — (d_pad/d)**0.5 · d_pad**-0.5 = d**-0.5."""
+
+    def wrapped(q, k, v):
+        q = q * (d_pad / q.shape[-1]) ** 0.5
+        return padded(fn, d_pad)(q, k, v)
+
+    return wrapped
+
+
+def measure(name, fn, reps: int = 8):
+    key = jax.random.key(time.time_ns() % (2**31))
+    kq, kk, kv, kw = jax.random.split(key, 4)
+    q = jax.random.normal(kq, (B, F, H, N, D), jnp.bfloat16)
+    k = jax.random.normal(kk, (B, H, N, D), jnp.bfloat16)
+    v = jax.random.normal(kv, (B, H, N, D), jnp.bfloat16)
+
+    jfn = jax.jit(fn)
+    try:
+        out = jfn(jax.random.normal(kw, q.shape, q.dtype), k, v)  # compile+warm
+        jax.block_until_ready(out)
+        float(out.ravel()[0].astype(jnp.float32))
+
+        t0 = time.time()
+        for _ in range(reps):
+            out = jfn(q, k, v)
+            # chain: next q depends on this output — no two calls share args
+            q = q + 0.001 * out
+        jax.block_until_ready(out)
+        float(out.ravel()[0].astype(jnp.float32))
+        dt = (time.time() - t0) / reps
+    except Exception as e:  # noqa: BLE001
+        print(f"{name:28s} FAILED: {type(e).__name__}: {str(e)[:120]}")
+        return None, None
+
+    # FLOPs: QK^T + PV = 2 * 2 * B*F*H*N*N*D
+    flops = 4 * B * F * H * N * N * D
+    # numerical parity vs dense at a small shape (full-shape dense scores
+    # are ~13 GB and OOM the chip outside the fused forward)
+    ks = jax.random.split(jax.random.key(7), 3)
+    qs = jax.random.normal(ks[0], (1, 2, 2, 1024, D), jnp.bfloat16)
+    kk2 = jax.random.normal(ks[1], (1, 2, 1024, D), jnp.bfloat16)
+    vs = jax.random.normal(ks[2], (1, 2, 1024, D), jnp.bfloat16)
+    small = jax.jit(fn)(qs, kk2, vs)
+    ref = jax.jit(dense_frame_attention)(qs, kk2, vs)
+    err = float(jnp.max(jnp.abs((small - ref).astype(jnp.float32))))
+    print(f"{name:28s} {dt*1e3:8.2f} ms   {flops/dt/1e12:6.1f} TF/s  max|d|={err:.4f}")
+    return dt, out
+
+
+def main():
+    reps = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+    print(f"shape: q=({B},{F},{H},{N},{D})  reps={reps}  "
+          f"device={jax.devices()[0].device_kind}")
+    measure("fused(256)", functools.partial(fused_frame_attention, q_blk=256), reps)
+    measure("fused(512)", functools.partial(fused_frame_attention, q_blk=512), reps)
+    measure("fused(1024)", functools.partial(fused_frame_attention, q_blk=1024), reps)
+    measure("dense", dense_frame_attention, reps)
+    measure("chunked(512)", functools.partial(chunked_frame_attention, q_chunk=512), reps)
+    measure("chunked(1024)", functools.partial(chunked_frame_attention, q_chunk=1024), reps)
+    measure("flash d40", flash_frame_attention, reps)
+    measure("flash_rect d40", flash_rect_frame_attention, reps)
+    measure("flash pad64", scaled_pad(flash_frame_attention, 64), reps)
+    measure("flash_rect pad64", scaled_pad(flash_rect_frame_attention, 64), reps)
+    measure("flash pad128", scaled_pad(flash_frame_attention, 128), reps)
+    measure("flash_rect pad128", scaled_pad(flash_rect_frame_attention, 128), reps)
+
+
+if __name__ == "__main__":
+    main()

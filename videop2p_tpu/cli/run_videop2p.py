@@ -122,7 +122,13 @@ def main(
     dtype = {"fp16": jnp.bfloat16, "bf16": jnp.bfloat16, "fp32": jnp.float32,
              "no": jnp.float32}[mixed_precision]
     bundle = build_models(
-        pretrained_model_path, dtype=dtype, frame_attention="chunked", tiny=tiny,
+        pretrained_model_path, dtype=dtype,
+        # single-chip: "auto" → the fused Pallas kernel on TPU (measured
+        # 19.6 s → 17.0 s fast-edit e2e vs dense, round-3 A/B; memory-bounded
+        # like chunked). Sharded: pjit cannot partition the custom call, so
+        # the mesh path stays on the chunked kernel.
+        frame_attention="chunked" if mesh else "auto",
+        tiny=tiny,
         seed=seed,
         # full mode differentiates through the UNet (null-text optimization);
         # per-block remat keeps that backward inside one chip's HBM

@@ -3,11 +3,13 @@
 from videop2p_tpu.ops.attention import (
     chunked_frame_attention,
     dense_frame_attention,
+    fused_frame_attention,
     make_frame_attention_fn,
 )
 
 __all__ = [
     "chunked_frame_attention",
     "dense_frame_attention",
+    "fused_frame_attention",
     "make_frame_attention_fn",
 ]
