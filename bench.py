@@ -37,11 +37,9 @@ import jax.numpy as jnp
 # TPU executables are content-addressed-cacheable; persisting them across
 # bench invocations cuts the multi-minute compile budget (the null-text remat
 # grad program alone) out of the driver's timeout window on re-runs.
-jax.config.update(
-    "jax_compilation_cache_dir",
-    os.environ.get("VIDEOP2P_BENCH_CACHE", "/root/.cache/videop2p_jax_tpu_cache"),
-)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+from videop2p_tpu.cli.common import enable_compile_cache  # noqa: E402
+
+enable_compile_cache("VIDEOP2P_BENCH_CACHE")
 
 V100_FAST_EDIT_S = 60.0  # reference: "~1 min on V100" (README.md:56-57)
 V100_OFFICIAL_EDIT_S = 600.0  # reference: "~10 min on V100" (README.md:59-60)

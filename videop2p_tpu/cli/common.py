@@ -25,9 +25,30 @@ __all__ = [
     "resolve_pipeline_dir",
     "build_models",
     "encode_prompts",
+    "enable_compile_cache",
     "setup_mesh",
     "ModelBundle",
 ]
+
+
+def enable_compile_cache(env_var: str = "VIDEOP2P_COMPILE_CACHE") -> None:
+    """Persist compiled TPU executables across CLI invocations.
+
+    The Stage-2 graph alone costs minutes of compile on a cold start (the
+    round-3 CLI drive spent ~2 min in the first VAE decode, nearly all
+    compile); a content-addressed on-disk cache makes every later run warm.
+    Called at the binary boundary (the CLI entry points and bench.py) — a
+    library import must not mutate global jax config. A cache dir configured
+    earlier in the process (e.g. the test suite's conftest) wins: this is a
+    default, not an override."""
+    if jax.config.jax_compilation_cache_dir:
+        return
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get(env_var,
+                       os.path.expanduser("~/.cache/videop2p_jax_tpu_cache")),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 
 def setup_mesh(bundle: "ModelBundle", mesh_spec: str, video_len: int):

@@ -29,6 +29,7 @@ from videop2p_tpu.cli.common import (
     encode_prompts,
     load_config,
     setup_mesh,
+    enable_compile_cache,
 )
 from videop2p_tpu.core import DDIMScheduler, DDPMScheduler, DependentNoiseSampler
 from videop2p_tpu.data import SingleVideoDataset
@@ -90,6 +91,7 @@ def main(
     **unused,
 ) -> str:
     del unused
+    enable_compile_cache()
     n_frames = int(train_data.get("n_sample_frames", 8))
     output_dir = output_dir + dependent_suffix(
         dependent=dependent, decay_rate=decay_rate, window_size=window_size,

@@ -29,6 +29,7 @@ from videop2p_tpu.cli.common import (
     load_config,
     resolve_pipeline_dir,
     setup_mesh,
+    enable_compile_cache,
 )
 from videop2p_tpu.control import make_controller
 from videop2p_tpu.core import DependentNoiseSampler
@@ -88,6 +89,7 @@ def main(
 ) -> Tuple[str, str]:
     """Returns the (inversion_gif, edit_gif) paths it wrote."""
     del unused
+    enable_compile_cache()
     if tiny and width == 512:
         # the tiny VAE downsamples 2×, not 8× — keep latents at the tiny
         # UNet's 8×8 working point so smoke runs stay small
