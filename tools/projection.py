@@ -181,7 +181,11 @@ def main() -> None:
         " forward are visible in the xplane op table"
         " (tools/xplane_top_ops.py); sharded==unsharded correctness is"
         " tests/test_parallel.py; the sharded 32-frame controlled edit runs"
-        " in the driver's multichip dryrun (__graft_entry__.py).",
+        " in the driver's multichip dryrun (__graft_entry__.py). The sharded"
+        " path runs the SAME fused Pallas kernel per shard"
+        " (parallel/mesh.py make_sharded_frame_attention_fn), so the 2-frame"
+        " single-chip proxy measures the per-chip compute of the mesh"
+        " faithfully.",
     ]
     docs = os.path.join(root, "docs")
     os.makedirs(docs, exist_ok=True)

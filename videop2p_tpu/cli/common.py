@@ -59,6 +59,7 @@ def setup_mesh(bundle: "ModelBundle", mesh_spec: str, video_len: int):
     from videop2p_tpu.parallel import (
         make_mesh,
         make_ring_temporal_fn,
+        make_sharded_frame_attention_fn,
         param_shardings,
     )
 
@@ -77,9 +78,12 @@ def setup_mesh(bundle: "ModelBundle", mesh_spec: str, video_len: int):
     print(f"[mesh] data={dp} frames={sp} tensor={tp}")
     if sp > 1:
         # ring attention on the uncontrolled temporal sites (training /
-        # inversion); controlled sites stay dense for the P2P edit
+        # inversion; controlled sites stay dense for the P2P edit), and the
+        # fused Pallas kernel on the sharded frame-attention sites via
+        # shard_map (pjit alone cannot partition a Pallas custom call)
         bundle.unet = bundle.unet.clone(
-            temporal_attention_fn=make_ring_temporal_fn(device_mesh)
+            temporal_attention_fn=make_ring_temporal_fn(device_mesh),
+            frame_attention_fn=make_sharded_frame_attention_fn(device_mesh),
         )
     bundle.unet_params = jax.device_put(
         bundle.unet_params,

@@ -127,8 +127,10 @@ def main(
         pretrained_model_path, dtype=dtype,
         # single-chip: "auto" → the fused Pallas kernel on TPU (measured
         # 19.6 s → 17.0 s fast-edit e2e vs dense, round-3 A/B; memory-bounded
-        # like chunked). Sharded: pjit cannot partition the custom call, so
-        # the mesh path stays on the chunked kernel.
+        # like chunked). With a frame-sharded mesh, setup_mesh overrides the
+        # seam with the shard_map wrapper (fused per shard); "chunked" here
+        # only covers the tensor-parallel-only mesh where GSPMD partitions
+        # the plain einsum itself.
         frame_attention="chunked" if mesh else "auto",
         tiny=tiny,
         seed=seed,

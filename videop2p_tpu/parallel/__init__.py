@@ -6,6 +6,7 @@ from videop2p_tpu.parallel.mesh import (
     AXIS_TENSOR,
     latent_sharding,
     make_mesh,
+    make_sharded_frame_attention_fn,
     param_shardings,
     replicated,
     shard_array,
@@ -27,6 +28,7 @@ __all__ = [
     "AXIS_TENSOR",
     "latent_sharding",
     "make_mesh",
+    "make_sharded_frame_attention_fn",
     "param_shardings",
     "replicated",
     "shard_array",

@@ -36,6 +36,7 @@ __all__ = [
     "text_sharding",
     "replicated",
     "param_shardings",
+    "make_sharded_frame_attention_fn",
     "shard_array",
 ]
 
@@ -72,6 +73,48 @@ def text_sharding(mesh: Mesh) -> NamedSharding:
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def make_sharded_frame_attention_fn(mesh: Mesh, impl: str = "auto"):
+    """Frame-attention kernel for the UNet's ``frame_attention_fn`` seam on a
+    device mesh: queries shard over ``frames`` (and batch/heads over
+    ``data``/``tensor``), the frame-0 K/V replicate across the frame axis —
+    the one broadcast the reference's shared-KV design needs (SURVEY §5.7).
+
+    Inside ``shard_map`` each chip runs the single-chip kernel on its local
+    frames — softmax rows are per-query, so the frame split is exact. This is
+    how the SHARDED path reaches the fused Pallas kernel: pjit/GSPMD cannot
+    partition a Pallas custom call on its own, but under shard_map the kernel
+    only ever sees local shards. ``impl`` resolves through
+    :func:`videop2p_tpu.ops.make_frame_attention_fn` per backend ("auto" →
+    fused on TPU, dense on CPU test meshes).
+    """
+    from videop2p_tpu.ops import dense_frame_attention, make_frame_attention_fn
+
+    inner = make_frame_attention_fn(impl) or dense_frame_attention
+
+    def fn(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+        # q (B, F, H, N, D); k/v (B, H, N, D) — frame-0 KV has no frame axis,
+        # so it replicates across the frames mesh axis (the shared-KV
+        # broadcast). Batch/head axes shard only when they divide the mesh
+        # axis (the Stage-2 edit batch is 3 CFG streams, which an even data
+        # axis cannot split — those axes then replicate instead).
+        b, f, h = q.shape[0], q.shape[1], q.shape[2]
+        ax_d = AXIS_DATA if b % mesh.shape[AXIS_DATA] == 0 else None
+        ax_t = AXIS_TENSOR if h % mesh.shape[AXIS_TENSOR] == 0 else None
+        if f % mesh.shape[AXIS_FRAMES] != 0:
+            raise ValueError(
+                f"'{AXIS_FRAMES}' mesh axis size {mesh.shape[AXIS_FRAMES]} "
+                f"must divide the frame axis {f}"
+            )
+        qspec = P(ax_d, AXIS_FRAMES, ax_t, None, None)
+        kvspec = P(ax_d, ax_t, None, None)
+        return jax.shard_map(
+            inner, mesh=mesh, in_specs=(qspec, kvspec, kvspec),
+            out_specs=qspec, check_vma=False,
+        )(q, k, v)
+
+    return fn
 
 
 def param_shardings(mesh: Mesh, params, *, tensor_parallel: bool = False):
