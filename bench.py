@@ -645,8 +645,11 @@ def main() -> None:
             from videop2p_tpu.models import UNet3DConditionModel, UNet3DConfig
             from videop2p_tpu.pipelines import make_unet_fn
 
+            # fused kernel: SDXL's 64-wide heads fit its VMEM tiles with no
+            # padding waste (on-chip readings: fused 723-756 ms vs chunked
+            # 837-894 ms across runs)
             sx_model = UNet3DConditionModel(
-                config=UNet3DConfig.sdxl(frame_attention="chunked"),
+                config=UNet3DConfig.sdxl(frame_attention="auto"),
                 dtype=jnp.bfloat16,
             )
             ks0, ks1, ks2, ks3 = jax.random.split(jax.random.fold_in(base, 77), 4)
