@@ -391,3 +391,38 @@ def test_null_text_chunked_matches_full(sched):
     np.testing.assert_allclose(
         np.asarray(chunked), np.asarray(full), rtol=2e-5, atol=2e-6
     )
+
+
+def test_cached_eps_replay_is_exact(sched, tiny):
+    """DDIM next_step/prev_step are linear in (x, ε) with identical
+    coefficients, so walking the inversion trajectory BACKWARD with the
+    cached per-step ε recovers every latent exactly — the property behind
+    the cached-source fast edit (ddim_inversion(return_eps=True)). The
+    reference's fast mode re-predicts ε from the drifting latent and only
+    approximately reconstructs; the cached replay is bit-tight."""
+    fn, params, cfg = tiny
+    x0 = 0.3 * jax.random.normal(jax.random.key(11), SHAPE)
+    cond = jax.random.normal(jax.random.key(12), (1, 77, cfg.cross_attention_dim))
+
+    traj, eps_seq = jax.jit(
+        lambda p, x: ddim_inversion(
+            fn, p, sched, x, cond, num_inference_steps=STEPS, return_eps=True
+        )
+    )(params, x0)
+    assert traj.shape[0] == STEPS + 1 and eps_seq.shape[0] == STEPS
+
+    timesteps = np.asarray(sched.timesteps(STEPS))[::-1]  # ascending walk order
+    for i in range(STEPS):
+        rec = sched.prev_step(eps_seq[i], timesteps[i], traj[i + 1], STEPS)
+        np.testing.assert_allclose(
+            np.asarray(rec), np.asarray(traj[i]), rtol=1e-5, atol=1e-6
+        )
+    # default call signature unchanged
+    traj_only = jax.jit(
+        lambda p, x: ddim_inversion(fn, p, sched, x, cond, num_inference_steps=STEPS)
+    )(params, x0)
+    # two separately-compiled programs (with/without the ε output) need not
+    # be bitwise identical — tight tolerance, not bit equality
+    np.testing.assert_allclose(
+        np.asarray(traj_only), np.asarray(traj), rtol=1e-6, atol=1e-7
+    )

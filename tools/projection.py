@@ -170,10 +170,10 @@ def main() -> None:
     lines += [
         "",
         "Run-to-run note: the 2-frame proxy phases are short (~2-4 s) and",
-        "carry tunnel timing variance — measured rounds gave projections of",
-        "6.84 s @ 0.62 (shard inversion 2.917 s) and 5.91 s @ 0.72 (1.973 s)",
-        "with identical code; both satisfy the <10 s target. The table below",
-        "uses the latest recorded readings.",
+        "carry tunnel timing variance. Historical spread with identical",
+        "code: 6.84 s @ 0.62 and 5.91 s @ 0.72 across measured rounds —",
+        "both satisfy the <10 s target. The bolded projection above uses",
+        "the latest recorded readings.",
     ]
     lines += [
         "",

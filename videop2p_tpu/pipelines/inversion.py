@@ -51,7 +51,8 @@ def ddim_inversion(
     dependent_weight: float = 0.0,
     dependent_sampler: Optional[DependentNoiseSampler] = None,
     key: Optional[jax.Array] = None,
-) -> jax.Array:
+    return_eps: bool = False,
+):
     """Invert clean latents x_0 to noise x_T.
 
     ``latents``: (B, F, h, w, C) clean (VAE-encoded, scaled) latents;
@@ -62,6 +63,16 @@ def ddim_inversion(
     ``[0] = x_0`` and ``[-1] = x_T`` (the reference's ``all_latent`` list).
     ``dependent_weight > 0`` blends the model output with AR noise:
     ``ε = (1-w)·ε̂ + w·ar_noise`` (run_videop2p.py:467-471).
+
+    ``return_eps``: also return the per-step model outputs
+    (num_steps, B, F, h, w, C), ordered along the inversion walk. DDIM's
+    ``next_step``/``prev_step`` are linear in (x, ε) with identical
+    coefficients, so ``prev_step(eps[i], t[i], trajectory[i+1])`` recovers
+    ``trajectory[i]`` EXACTLY — a cached-ε backward replay of the source
+    stream is exact where the reference's fast mode re-predicts ε from the
+    drifting latent (pipeline_tuneavideo.py:412-415) and only approximately
+    reconstructs. This is the seam for replaying the source stream without
+    re-running its forwards (tests/test_pipelines.py pins the property).
     """
     # latents stay float32 through the walk regardless of the UNet's compute
     # dtype — scheduler math is fp32 (the reference keeps the Stage-2 UNet and
@@ -83,10 +94,17 @@ def ddim_inversion(
             ar_noise = dependent_sampler.sample_like(sub, eps)
             eps = (1.0 - dependent_weight) * eps + dependent_weight * ar_noise
         latent = scheduler.next_step(eps, t, latent, num_inference_steps)
-        return (latent, key), latent
+        # return_eps is static: without it the scan must not stack a dead
+        # trajectory-sized ε buffer (eager callers get no DCE)
+        ys = (latent, eps.astype(jnp.float32)) if return_eps else latent
+        return (latent, key), ys
 
-    (_, _), trajectory = jax.lax.scan(body, (latents, key), timesteps)
-    return jnp.concatenate([latents[None], trajectory], axis=0)
+    (_, _), ys = jax.lax.scan(body, (latents, key), timesteps)
+    trajectory, eps_seq = ys if return_eps else (ys, None)
+    full = jnp.concatenate([latents[None], trajectory], axis=0)
+    if return_eps:
+        return full, eps_seq
+    return full
 
 
 def null_text_optimization(
