@@ -76,18 +76,26 @@ def _tools_import(name: str):
 
 
 def _hard_sync(out) -> None:
-    """Fetch a few real bytes from every output leaf — a barrier an
-    async/early-returning dispatch path cannot fake.
+    """Fetch real bytes from the output — a barrier an async/early-returning
+    dispatch path cannot fake.
 
     ``block_until_ready`` through the axon tunnel has been observed returning
     before the device work completed (round-2 sub-floor readings with fresh
     inputs but different, plausible outputs — consistent with the tunnel
     acking the dispatch, not the execution). Transferring output VALUES to the
     host cannot complete until the producing programs have actually run.
+
+    ONE leaf's value is fetched: every ``measure_with_floor`` call times a
+    single jitted program, whose outputs all come from the same execution —
+    one value proves the whole program ran. A per-leaf fetch was measured at
+    ~100 ms of tunnel round-trips PER LEAF (3.7 s of fake time on the
+    35-leaf captured-inversion output, round 4), which contaminated the
+    timing window it was supposed to protect.
     """
     for leaf in jax.tree.leaves(out):
         if hasattr(leaf, "ravel"):
             float(jnp.asarray(leaf).ravel()[0].astype(jnp.float32))
+            return
 
 
 def hard_block(out):
@@ -172,19 +180,26 @@ def measure_with_floor(call, fresh_inputs, floor_s: float, what: str) -> Reading
             )
             if tracing:
                 try:
-                    dev_s = _tools_import("profile_xplane").module_device_seconds(tdir)
+                    px = _tools_import("profile_xplane")
+                    dev_s = px.module_device_seconds(tdir)
+                    span_s = px.module_device_span_seconds(tdir)
                 except Exception as e:  # noqa: BLE001
                     print(f"[bench] {what}: device-trace readout failed ({e})",
                           file=sys.stderr, flush=True)
-                    dev_s = 0.0
+                    dev_s = span_s = 0.0
                 if dev_s >= floor_s:
+                    # the summed module durations clear the floor (programs
+                    # really executed), but overlapping async programs can
+                    # make the SUM exceed wall-clock — report the envelope
+                    # span (first start → last end), which cannot
                     print(
                         f"[bench] {what}: device trace records {dev_s:.3f}s of "
-                        f"program execution — using it as the reading",
+                        f"program execution over a {span_s:.3f}s span — using "
+                        "the span as the reading",
                         file=sys.stderr,
                         flush=True,
                     )
-                    return Reading(out, dev_s, False, "device_trace", x)
+                    return Reading(out, max(span_s, floor_s), False, "device_trace", x)
                 print(
                     f"[bench] {what}: device trace total {dev_s:.3f}s is also "
                     f"sub-floor — flagging the reading as suspect",
@@ -280,7 +295,8 @@ class DetailsRecorder:
 
 
 def build_fast_edit_working_point(*, num_frames: int = 8, num_steps: int = 50,
-                                  frame_attention: str = "auto"):
+                                  frame_attention: str = "auto",
+                                  cached: bool = False):
     """The reference's headline scenario, shared by the bench phases and the
     xplane profiler (tools/profile_xplane.py): rabbit-jump-p2p refine +
     reweight + LocalBlend at ``num_frames`` × 64×64 latents, ``num_steps``
@@ -293,13 +309,23 @@ def build_fast_edit_working_point(*, num_frames: int = 8, num_steps: int = 50,
     SERVER-side, across processes — a fixed seed would let a later run replay
     cached results in ~0 s — and the warm-up input differs from the measured
     one for the same reason.
+
+    ``cached=True`` additionally builds the cached-source pair
+    (``invert_captured``/``edit_cached``, pipelines/cached.py): capture
+    windows follow the CLI's gate rule (cross 0.2 → 10 steps, self 0.5 →
+    (0, 25) at 50 steps; ~3.1 GiB of maps at 8 frames).
     """
     from types import SimpleNamespace
 
     from videop2p_tpu.control import make_controller
     from videop2p_tpu.core import DDIMScheduler
     from videop2p_tpu.models import UNet3DConditionModel, UNet3DConfig
-    from videop2p_tpu.pipelines import ddim_inversion, edit_sample, make_unet_fn
+    from videop2p_tpu.pipelines import (
+        ddim_inversion,
+        ddim_inversion_captured,
+        edit_sample,
+        make_unet_fn,
+    )
     from videop2p_tpu.utils.tokenizers import WordTokenizer
 
     model = UNet3DConditionModel(
@@ -342,9 +368,30 @@ def build_fast_edit_working_point(*, num_frames: int = 8, num_steps: int = 50,
         )
     )
     x_warm = jax.random.normal(k7, x0.shape, x0.dtype)
+
+    invert_captured = edit_cached = None
+    if cached:
+        from videop2p_tpu.pipelines.cached import capture_windows
+
+        cross_len, self_window = capture_windows(ctx, num_steps)
+        invert_captured = jax.jit(
+            lambda p, x: ddim_inversion_captured(
+                fn, p, sched, x, cond[:1], num_inference_steps=num_steps,
+                cross_len=cross_len, self_window=self_window, capture_blend=True,
+            )
+        )
+        edit_cached = jax.jit(
+            lambda p, xt, cch: edit_sample(
+                fn, p, sched, xt, cond, uncond,
+                num_inference_steps=num_steps, ctx=ctx, source_uses_cfg=False,
+                cached_source=cch,
+            )
+        )
+
     return SimpleNamespace(
         invert=invert, edit=edit, fn=fn, params=params, sched=sched, ctx=ctx,
         cond=cond, uncond=uncond, x0=x0, x_warm=x_warm, base=base,
+        invert_captured=invert_captured, edit_cached=edit_cached,
     )
 
 
@@ -353,7 +400,7 @@ def main() -> None:
     from videop2p_tpu.pipelines import edit_sample, make_unet_fn, null_text_optimization
 
     F, STEPS = 8, 50
-    wp = build_fast_edit_working_point(num_frames=F, num_steps=STEPS)
+    wp = build_fast_edit_working_point(num_frames=F, num_steps=STEPS, cached=True)
     invert, edit, params = wp.invert, wp.edit, wp.params
     fn, sched, ctx = wp.fn, wp.sched, wp.ctx
     cond, uncond, x0, x_warm, base = wp.cond, wp.uncond, wp.x0, wp.x_warm, wp.base
@@ -364,27 +411,33 @@ def main() -> None:
     )
     fn_remat = make_unet_fn(model_remat)
 
+    # headline = the cached-source fast mode (the CLI default,
+    # pipelines/cached.py): the inversion walk captures the controlled-site
+    # maps + blend contributions, and the edit then runs only TWO UNet
+    # streams — the source stream replays the trajectory exactly.
     # warm-up (compile) on a DIFFERENT input: memoized identical calls would
     # fake a near-zero wall-clock for the measured run
-    out = hard_block(edit(params, invert(params, x_warm)[-1]))
+    warm_traj, warm_cached = wp.invert_captured(params, x_warm)
+    out = hard_block(wp.edit_cached(params, warm_traj[-1], warm_cached))
 
     peak = _peak_flops()
-    # fast mode: inversion is 1 cond stream; the edit batch is 3 streams
-    # (edit-uncond + 2 cond; the source's unused uncond forward is skipped)
+    # inversion is 1 cond stream (map capture adds HBM writes, no FLOPs); the
+    # cached edit batch is 2 streams (edit uncond + edit cond — the source
+    # stream is replayed, not recomputed)
     inv_flops = FLOPS_PER_FRAME_FWD * 1 * F * STEPS
-    edit_flops = FLOPS_PER_FRAME_FWD * 3 * F * STEPS
+    edit_flops = FLOPS_PER_FRAME_FWD * 2 * F * STEPS
     suspect = []
 
     k_r1, k_r2 = jax.random.split(jax.random.fold_in(base, 7))
     r_inv = measure_with_floor(
-        lambda x: invert(params, x),
+        lambda x: wp.invert_captured(params, x),
         [x0] + [jax.random.normal(k, x0.shape, x0.dtype) for k in (k_r1, k_r2)],
         inv_flops / peak,
         "inversion",
     )
-    traj, inv_s = r_inv.out, r_inv.seconds
+    (traj, cached_src), inv_s = r_inv.out, r_inv.seconds
     r_edit = measure_with_floor(
-        lambda xt: edit(params, xt),
+        lambda xt: wp.edit_cached(params, xt, cached_src),
         # value-fresh x_T per attempt (wall-clock is value-independent)
         [traj[-1], traj[-1] + 0.001, traj[-1] - 0.001],
         edit_flops / peak,
@@ -394,6 +447,12 @@ def main() -> None:
     elapsed = inv_s + edit_s
 
     assert bool(jnp.isfinite(out.astype(jnp.float32)).all()), "non-finite output"
+    # the cached replay guarantee, checked on-chip: the edit's source stream
+    # IS the inversion input (max |out[0] − x_0| must be exactly 0)
+    src_err = float(
+        jnp.max(jnp.abs(out[0].astype(jnp.float32) - traj[0][0].astype(jnp.float32)))
+    )
+    assert src_err == 0.0, f"cached source replay not exact: {src_err}"
 
     breakdown = {
         "device": jax.devices()[0].device_kind,
@@ -411,22 +470,6 @@ def main() -> None:
     if peak == peak:  # known peak-FLOPs device only (NaN is not valid JSON)
         rec.record("mfu_inversion", round(inv_flops / inv_s / peak, 3), derived=(r_inv,))
         rec.record("mfu_edit", round(edit_flops / edit_s / peak, 3), derived=(r_edit,))
-
-    # The BASELINE.json north-star (<10 s) is set for a v5e-4 slice; this
-    # harness has ONE chip. The 4-chip projection comes from the committed
-    # bandwidth model (tools/projection.py → docs/PROJECTION.md): per-frame
-    # compute divides by sp=4 (--mesh 1,4,1; tests/test_parallel.py proves
-    # sharded==unsharded), plus the enumerated per-site ICI traffic (frame-0
-    # KV broadcast + controlled-temporal all-gather) at a conservative
-    # 100 GB/s effective ingress with no overlap assumed.
-    try:
-        project = _tools_import("projection").project
-        proj = project(inv_s, edit_s, steps=STEPS, frames=F)
-        rec.record("projected_v5e4_s", proj["projected_v5e4_s"], derived=(r_inv, r_edit))
-        rec.record("projected_v5e4_efficiency", proj["parallel_efficiency"],
-                   derived=(r_inv, r_edit))
-    except Exception as e:  # noqa: BLE001 — projection is derived, never fatal
-        print(f"[bench] projection model failed: {e}", file=sys.stderr, flush=True)
 
     # print the metric of record NOW: the extended phases below (null-text,
     # official mode, tuning step) take ~25 more minutes of compiles and
@@ -448,12 +491,97 @@ def main() -> None:
         # Any extended-phase failure (OOM, tunnel flake) must not cost the
         # round its primary record: partial breakdown still gets written.
         try:
-            # Stage-1 tuning step at the reference working point (8 frames, 64²
-            # latents, masked AdamW on the attention projections, per-block
-            # remat): the reference does 300 steps in ~20 min on a T4
-            # (gradio_utils/app_training.py:86) ≈ 4 s/step
             from videop2p_tpu.core import DDPMScheduler
             from videop2p_tpu.train import TrainState, TuneConfig, make_optimizer, train_step
+
+            # ---- live-source A/B: the reference-faithful fast mode (live
+            # 3-stream edit) against the cached headline above — the bench
+            # line VERDICT r3 item 1 asks for ----------------------------
+            x_t = traj[-1]
+            # actually release the ~3.1 GiB capture tree: the Reading tuples
+            # keep r_inv.out/r_edit.out alive through the whole extended
+            # section, so dropping the locals alone frees nothing
+            r_inv = r_inv._replace(out=None)
+            r_edit = r_edit._replace(out=None)
+            del out, warm_traj, warm_cached, cached_src
+            jax.clear_caches()
+            hard_block(wp.edit(params, wp.invert(params, x_warm)[-1]))
+            r_linv = measure_with_floor(
+                lambda x: wp.invert(params, x),
+                [x0 + 0.002, x0 - 0.002],
+                inv_flops / peak,
+                "inversion (live)",
+            )
+            r_ledit = measure_with_floor(
+                lambda xt: wp.edit(params, xt),
+                [x_t, x_t + 0.001],
+                FLOPS_PER_FRAME_FWD * 3 * F * STEPS / peak,
+                "edit (live)",
+            )
+            inv_live_s, edit_live_s = r_linv.seconds, r_ledit.seconds
+            rec.record("inversion_live_s", round(inv_live_s, 3), reading=r_linv)
+            rec.record("edit_live_s", round(edit_live_s, 3), reading=r_ledit)
+            rec.record("fast_edit_e2e_live_s", round(inv_live_s + edit_live_s, 3),
+                       derived=(r_linv, r_ledit))
+            # what the map capture adds to the inversion walk — the cost side
+            # of the cached mode's 3→2-stream edit saving
+            rec.record("capture_overhead_s", round(inv_s - inv_live_s, 3),
+                       derived=(r_inv, r_linv))
+            if peak == peak:
+                rec.record(
+                    "mfu_edit_live",
+                    round(FLOPS_PER_FRAME_FWD * 3 * F * STEPS / edit_live_s / peak, 3),
+                    derived=(r_ledit,),
+                )
+
+            # The BASELINE.json north-star (<10 s) is a v5e-4 slice; this
+            # harness has ONE chip. The projection models the LIVE sharded
+            # path (the cached capture is single-chip for now), so it feeds
+            # on the live A/B numbers; the shard-measured refinement below
+            # overrides it.
+            try:
+                project = _tools_import("projection").project
+                proj = project(inv_live_s, edit_live_s, steps=STEPS, frames=F)
+                rec.record("projected_v5e4_s", proj["projected_v5e4_s"],
+                           derived=(r_linv, r_ledit))
+                rec.record("projected_v5e4_efficiency", proj["parallel_efficiency"],
+                           derived=(r_linv, r_ledit))
+                rec.record("projected_v5e4_model",
+                           proj["assumptions"]["compute_scaling"],
+                           derived=(r_linv, r_ledit))
+            except Exception as e:  # noqa: BLE001 — derived, never fatal
+                print(f"[bench] projection model failed: {e}", file=sys.stderr,
+                      flush=True)
+
+            # ---- on-TPU fused-vs-chunked exactness gate (VERDICT r3 item
+            # 5): same math, different kernels, at the 64²-edit site shape.
+            # A Mosaic/layout regression would corrupt outputs while perf
+            # still looks fine — this fails loudly instead. (Chunked is the
+            # dense math scanned over query blocks; the full dense score
+            # tensor at this shape is 4.3 GB and needless.) --------------
+            from videop2p_tpu.ops.attention import (
+                chunked_frame_attention,
+                fused_frame_attention,
+            )
+
+            kg = jax.random.fold_in(base, 31)
+            gq = jax.random.normal(kg, (1, F, 8, 4096, 40), jnp.bfloat16)
+            gk = jax.random.normal(jax.random.fold_in(base, 32), (1, 8, 4096, 40),
+                                   jnp.bfloat16)
+            gv = jax.random.normal(jax.random.fold_in(base, 33), (1, 8, 4096, 40),
+                                   jnp.bfloat16)
+            gate = jax.jit(
+                lambda q, k, v: jnp.max(jnp.abs(
+                    fused_frame_attention(q, k, v, 256).astype(jnp.float32)
+                    - chunked_frame_attention(q, k, v).astype(jnp.float32)
+                ))
+            )
+            gate_diff = float(hard_block(gate(gq, gk, gv)))
+            rec.record("fused_kernel_maxdiff_vs_chunked", round(gate_diff, 6))
+            assert gate_diff < 0.05, (
+                f"fused kernel diverges from chunked math on-chip: {gate_diff}"
+            )
+            del gq, gk, gv
 
             # refine the v5e-4 projection with a MEASURED per-chip shard:
             # the F/sp=2-frame working point is exactly what one chip of the
@@ -478,39 +606,70 @@ def main() -> None:
             rec.record("shard2_edit_s", round(r_sedit.seconds, 3), reading=r_sedit)
             try:
                 _project = _tools_import("projection").project
-                proj = _project(inv_s, edit_s, steps=STEPS, frames=F,
+                proj = _project(inv_live_s, edit_live_s, steps=STEPS, frames=F,
                                 shard_inv_s=r_sinv.seconds,
                                 shard_edit_s=r_sedit.seconds)
                 rec.record("projected_v5e4_s", proj["projected_v5e4_s"],
-                           derived=(r_inv, r_edit, r_sinv, r_sedit))
+                           derived=(r_linv, r_ledit, r_sinv, r_sedit))
                 rec.record("projected_v5e4_efficiency", proj["parallel_efficiency"],
-                           derived=(r_inv, r_edit, r_sinv, r_sedit))
+                           derived=(r_linv, r_ledit, r_sinv, r_sedit))
+                rec.record("projected_v5e4_model",
+                           proj["assumptions"]["compute_scaling"],
+                           derived=(r_linv, r_ledit, r_sinv, r_sedit))
             except Exception as e:  # noqa: BLE001
                 print(f"[bench] shard projection failed: {e}", file=sys.stderr, flush=True)
             del ws, r_sinv, r_sedit
             jax.clear_caches()
 
-            # warm inversion input for the null phase — plus a spare trajectory
-            # as the value-fresh retry input for the floor check — while the
-            # inversion executable is still loaded, then drop the fast-phase
-            # programs: each later phase needs the chip's HBM close to free
+            # warm inversion input for the null phases — plus a spare
+            # trajectory as the value-fresh retry input for the floor check —
+            # while the inversion executable is still loaded, then drop the
+            # fast-phase programs: later phases need the HBM close to free
             warm_traj = hard_block(invert(params, x_warm))
             x_extra = jax.random.normal(jax.random.fold_in(base, 55), x0.shape, x0.dtype)
             traj_extra = hard_block(invert(params, x_extra))
             warm_last = warm_traj[-1]
-            del out
             jax.clear_caches()
 
-            # null-text inversion: 50 outer steps × ≤10 inner Adam steps on the
-            # uncond embedding (run_videop2p.py:580-612) — the official mode's
-            # dominant cost and the declared metric of record (BASELINE.json)
-            # chunked outer scan: the full 50-step program is one multi-minute
-            # device call, which the TPU runtime's execution watchdog kills
-            def null_opt(p, tr):
+            # null-text inversion, FIXED-WORK variant (VERDICT r3 item 3):
+            # exactly 3 inner Adam steps per outer step, no early stop — the
+            # work is weight-independent, so this wall-clock is stable where
+            # the reference-faithful early-stopped run (measured LAST, below)
+            # spreads 157–418 s with the random stop point. The per-inner-
+            # step ms includes the 2 per-outer forwards (cond + final uncond)
+            # smeared in — disclosed, and constant across runs.
+            INNER_FIXED = 3
+
+            def null_opt(p, tr, *, inner, early_stop):
                 return null_text_optimization(
                     fn_remat, p, sched, tr, cond[:1], uncond[None],
                     num_inference_steps=STEPS, guidance_scale=7.5, outer_chunk=10,
+                    num_inner_steps=inner, early_stop=early_stop,
                 )
+
+            # no separate warm run: the chunk program loads from the
+            # persistent compile cache inside the first measured call (a few
+            # seconds of over-statement on a ~60 s reading, disclosed here;
+            # a second full execution would cost the driver's budget more)
+            r_nfix = measure_with_floor(
+                lambda tr: null_opt(params, tr, inner=INNER_FIXED, early_stop=False),
+                [traj, traj_extra],
+                # per outer step: 2 forwards + INNER_FIXED × (forward + a
+                # backward that is ≥ 2 forward-equivalents)
+                (2 + 3 * INNER_FIXED) * STEPS * F * FLOPS_PER_FRAME_FWD / peak,
+                "null-text fixed",
+            )
+            null_seq, nfix_s = r_nfix.out, r_nfix.seconds
+            rec.record("null_text_fixed3_s", round(nfix_s, 3), reading=r_nfix)
+            rec.record("null_text_inner_step_ms",
+                       round(nfix_s / (STEPS * INNER_FIXED) * 1e3, 1),
+                       derived=(r_nfix,))
+            null_traj_last = r_nfix.x_used[-1]
+            jax.clear_caches()
+
+            # official-mode controlled edit (full CFG + per-step null
+            # injection); its e2e sum is recorded after the early-stopped
+            # null-text phase at the end supplies the faithful null time
             edit_official = jax.jit(
                 lambda p, xt, ns: edit_sample(
                     fn, p, sched, xt, cond, uncond,
@@ -518,24 +677,7 @@ def main() -> None:
                     null_uncond_embeddings=ns,
                 )
             )
-            warm_null = hard_block(null_opt(params, warm_traj))
-            # floor: even if every inner Adam loop early-stops at 0 iterations,
-            # each of the 50 outer steps runs 2 forwards (cond + final uncond)
-            r_null = measure_with_floor(
-                lambda tr: null_opt(params, tr),
-                [traj, traj_extra],
-                2 * STEPS * F * FLOPS_PER_FRAME_FWD / peak,
-                "null-text",
-            )
-            null_seq, null_s = r_null.out, r_null.seconds
-            rec.record("null_text_wall_s", round(null_s, 3), reading=r_null)
-            # the (x_T, null-embeddings) pair fed to the official edit is the
-            # one the ACCEPTED null-text reading actually produced
-            null_traj_last = r_null.x_used[-1]
-            del traj, warm_traj, traj_extra
-            jax.clear_caches()
-
-            hard_block(edit_official(params, warm_last, warm_null))
+            hard_block(edit_official(params, warm_last, null_seq))
             r_off = measure_with_floor(
                 lambda xt: edit_official(params, xt, null_seq),
                 # value-fresh x_T per attempt
@@ -545,15 +687,10 @@ def main() -> None:
             )
             out_off, edit_off_s = r_off.out, r_off.seconds
             rec.record("official_edit_s", round(edit_off_s, 3), reading=r_off)
-            official = inv_s + null_s + edit_off_s
-            rec.record("official_edit_e2e_s", round(official, 3),
-                       derived=(r_inv, r_null, r_off))
-            rec.record("official_vs_baseline", round(V100_OFFICIAL_EDIT_S / official, 2),
-                       derived=(r_inv, r_null, r_off))
 
-            # Stage-1 tuning step, measured LAST on a cleared chip (its grad
-            # program + optimizer state need the HBM to themselves)
-            del out_off, null_seq, warm_null
+            # Stage-1 tuning step on a cleared chip (its grad program +
+            # optimizer state need the HBM to themselves)
+            del out_off, null_seq
             jax.clear_caches()
             tune_cfg = TuneConfig()
             tx = make_optimizer(tune_cfg)
@@ -610,26 +747,35 @@ def main() -> None:
             jax.clear_caches()
 
             # Long-video working point (BASELINE configs 3/5: tiger-forest is
-            # 24 frames; the 32-frame edit is the v5e-8 case): 24-frame fast edit
-            # on ONE chip. Dense frame attention cannot run here — the 64²-site
-            # scores alone are 3·24·8·4096² bf16 ≈ 19 GB > HBM — so this measures
-            # the fused Pallas kernel ("auto" on TPU, ops/attention.py): VMEM-
-            # bounded like the old chunked path and faster (round-3 A/B).
-            F_LONG = 24
+            # 24 frames; the 32-frame edit is the v5e-8 case): 24-frame fast
+            # edit on ONE chip with the fused Pallas kernel (dense frame
+            # attention cannot run here — the 64²-site scores alone are
+            # 3·24·8·4096² bf16 ≈ 19 GB > HBM). Run at 10 DDIM steps to fit
+            # the driver's budget: per-step time is step-count-independent
+            # (identical per-step program inside the scan), so the 50-step
+            # number is the measured per-step rate × 50, recorded as
+            # *_extrapolated. r3 measured the full 50 steps at 50.232 s;
+            # the extrapolation reproduces it to within run noise.
+            F_LONG, STEPS_LONG = 24, 10
             wl = build_fast_edit_working_point(
-                num_frames=F_LONG, num_steps=STEPS, frame_attention="auto"
+                num_frames=F_LONG, num_steps=STEPS_LONG, frame_attention="auto"
             )
             hard_block(wl.edit(wl.params, wl.invert(wl.params, wl.x_warm)[-1]))
             r_long = measure_with_floor(
                 lambda x: wl.edit(wl.params, wl.invert(wl.params, x)[-1]),
                 [wl.x0, wl.x0 + 0.001],  # value-fresh per attempt
-                4 * F_LONG * STEPS * FLOPS_PER_FRAME_FWD / peak,  # 1+3 streams
+                4 * F_LONG * STEPS_LONG * FLOPS_PER_FRAME_FWD / peak,  # 1+3 streams
                 "long24",
             )
             out_long, long_s = r_long.out, r_long.seconds
             assert bool(jnp.isfinite(out_long.astype(jnp.float32)).all())
-            rec.record("long24_fast_edit_e2e_s", round(long_s, 3), reading=r_long)
-            rec.record("long24_frames_per_sec", round(F_LONG / long_s, 3), derived=(r_long,))
+            long_50 = long_s * STEPS / STEPS_LONG
+            rec.record("long24_fast_edit_10step_s", round(long_s, 3), reading=r_long)
+            rec.record("long24_fast_edit_e2e_s_extrapolated", round(long_50, 3),
+                       derived=(r_long,))
+            rec.record("long24_frames_per_sec", round(F_LONG / long_50, 3),
+                       derived=(r_long,))
+            rec.drop("long24_fast_edit_e2e_s")  # renamed *_extrapolated
             del out_long, wl
             jax.clear_caches()
 
@@ -692,7 +838,76 @@ def main() -> None:
             rec.record("sdxl_params_b", round(
                 sum(s.size for _, s in sx_leaves) / 1e9, 2
             ))
-            del sx_out, sx_params
+            del sx_out
+
+            # SDXL CONTROLLED edit step (VERDICT r3 item 8): one refine +
+            # equalizer step through the fast-mode 3-stream batch at 128²
+            # latents / 2048-dim context — the controlled sites' materialized
+            # probabilities at this shape are the actual memory risk BASELINE
+            # config 4 stresses (the biggest, a 64²-query site, holds
+            # B·F×H×4096×77 per instance).
+            from videop2p_tpu.control import make_controller
+            from videop2p_tpu.utils.tokenizers import WordTokenizer
+
+            sx_ctx = make_controller(
+                ["a rabbit is jumping on the grass",
+                 "a origami rabbit is jumping on the grass"],
+                WordTokenizer(), num_steps=1,
+                is_replace_controller=False,
+                cross_replace_steps=1.0, self_replace_steps=1.0,
+                equalizer_params={"words": ["origami"], "values": [2.0]},
+            )
+            sx_cond2 = jax.random.normal(
+                jax.random.fold_in(base, 78), (2, 77, 2048), jnp.bfloat16
+            )
+            sx_unc = jnp.zeros((77, 2048), jnp.bfloat16)
+            sx_edit1 = jax.jit(
+                lambda p, xt: edit_sample(
+                    sx_fn, p, sched, xt, sx_cond2, sx_unc,
+                    num_inference_steps=1, ctx=sx_ctx, source_uses_cfg=False,
+                )
+            )
+            hard_block(sx_edit1(sx_params, sx + 0.002))
+            r_sxc = measure_with_floor(
+                lambda xt: sx_edit1(sx_params, xt),
+                [sx, sx + 0.001],
+                3 * 8 * 2.6e12 / peak,  # 3 streams × 8 frames × SDXL-fwd bound
+                "sdxl controlled step",
+            )
+            assert bool(jnp.isfinite(r_sxc.out.astype(jnp.float32)).all())
+            rec.record("sdxl_ctrl_step_ms", round(r_sxc.seconds * 1e3, 0),
+                       reading=r_sxc)
+            del sx_params, r_sxc
+            jax.clear_caches()
+
+            # reference-faithful null-text inversion LAST (50 outer × ≤10
+            # early-stopped inner steps, run_videop2p.py:580-612): its
+            # weight-dependent 157–418 s spread is disclosed in README; the
+            # stable number of record is null_text_fixed3_s above. Last so a
+            # driver timeout costs only this tail, not the whole record.
+            r_null = measure_with_floor(
+                lambda tr: null_opt(params, tr, inner=10, early_stop=True),
+                [traj, traj_extra],
+                # even if every inner loop stops at 0 iterations, each outer
+                # step runs 2 forwards (cond + final uncond)
+                2 * STEPS * F * FLOPS_PER_FRAME_FWD / peak,
+                "null-text",
+            )
+            null_s = r_null.seconds
+            rec.record("null_text_wall_s", round(null_s, 3), reading=r_null)
+            # no warm execution precedes this phase (a second full run costs
+            # 157–418 s of driver budget): on a cold compile cache the
+            # early-stop chunk program's compile/load lands INSIDE the
+            # reading. That only overstates our time (conservative for every
+            # derived speedup); recorded so the provenance is machine-readable
+            rec.record("null_text_warm", "none — may include compile-cache load")
+            official = inv_live_s + null_s + edit_off_s
+            rec.record("official_edit_e2e_s", round(official, 3),
+                       derived=(r_linv, r_null, r_off))
+            rec.record("official_vs_baseline",
+                       round(V100_OFFICIAL_EDIT_S / official, 2),
+                       derived=(r_linv, r_null, r_off))
+            del r_null, traj, warm_traj, traj_extra
             jax.clear_caches()
             rec.drop("extended_error")  # this run's extended phases all passed
 

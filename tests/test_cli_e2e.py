@@ -156,7 +156,10 @@ def test_two_stage_real_weights_no_backfill(source_pipeline_dir, tmp_path):
     from videop2p_tpu.cli.run_videop2p import main as p2p
 
     with warnings.catch_warnings():
-        warnings.simplefilter("error", UserWarning)  # backfill warning = fail
+        # only the random-init backfill warnings fail the test — a blanket
+        # simplefilter("error", UserWarning) would also escalate unrelated
+        # torch/transformers deprecation warnings raised under the run
+        warnings.filterwarnings("error", message=".*RANDOM-INIT.*")
         out = tune(
             pretrained_model_path=source_pipeline_dir,
             output_dir=str(tmp_path / "exp"),
@@ -184,6 +187,58 @@ def test_two_stage_real_weights_no_backfill(source_pipeline_dir, tmp_path):
             video_len=2, width=16, fast=True,
         )
     assert os.path.isfile(inv_gif) and os.path.isfile(edit_gif)
+
+
+def test_stage2_reuses_persisted_inversion(tuned_dir, capsys):
+    """VERDICT r3 item 7: a second edit of the same clip must skip DDIM
+    inversion (and null-text in full mode) by loading the persisted products;
+    iterating on the edit prompt is then cheap."""
+    from videop2p_tpu.cli.run_videop2p import main as p2p
+
+    base = tuned_dir.rsplit("_dependent", 1)[0]
+    # a source prompt no other test uses — the cache key covers the source
+    # prompt, so this test controls its own entry even though the fixture dir
+    # (and its inv_cache) is shared module-wide
+    src = "a rabbit is jumping quickly"
+    kw = dict(
+        pretrained_model_path=base,
+        image_path="data/rabbit",
+        prompt=src,
+        prompts=[src, "a origami rabbit is jumping quickly"],
+        is_word_swap=False, video_len=2, fast=False, tiny=True,
+        num_inner_steps=2,
+    )
+    p2p(save_name="reuse_a", **kw)
+    first = capsys.readouterr().out
+    assert "reusing persisted inversion" not in first
+    cache_root = os.path.join(tuned_dir, "results_dpFalse", "inv_cache")
+    assert os.path.isdir(cache_root)
+    from videop2p_tpu.utils.inv_cache import load_inversion
+
+    keys = [
+        k for k in os.listdir(cache_root)
+        if load_inversion(
+            os.path.join(tuned_dir, "results_dpFalse"), k,
+            want_null=True, null_tag="_i2",
+        ) is not None
+    ]
+    entries = [
+        os.path.join(cache_root, k) for k in keys
+        if os.path.isfile(os.path.join(cache_root, k, "null_embeddings_i2.npy"))
+    ]
+    assert entries, f"no entry with null embeddings under {cache_root}"
+
+    # second run, different EDIT prompt (source stays src) — same
+    # clip+source ⇒ full reuse
+    kw["prompts"] = [src, "a plush rabbit is jumping quickly"]
+    _, gif = p2p(save_name="reuse_b", **kw)
+    second = capsys.readouterr().out
+    assert "skipping DDIM inversion and null-text optimization" in second
+    assert os.path.isfile(gif)
+
+    # opting out must bypass the cache
+    p2p(save_name="reuse_c", reuse_inversion=False, **kw)
+    assert "reusing persisted inversion" not in capsys.readouterr().out
 
 
 def test_stage2_no_blend_path(tuned_dir):

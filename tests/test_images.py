@@ -111,3 +111,41 @@ def test_text2image_stable_controlled(tiny_vae):
     )
     assert images.shape == (2, 16, 16, 3) and images.dtype == np.uint8
     assert latent.shape == (1, 8, 8, 4)
+
+
+@pytest.mark.slow
+def test_text2image_ldm_controlled():
+    """The BERT/VQ-VAE legacy variant (ptp_utils.py:112-139): caller-supplied
+    embeddings + a VQ decoder fn around the same controlled denoise scan."""
+    from videop2p_tpu.control import make_controller
+    from videop2p_tpu.core import DDIMScheduler
+    from videop2p_tpu.models import UNet3DConditionModel, UNet3DConfig
+    from videop2p_tpu.pipelines import make_unet_fn
+    from videop2p_tpu.utils.images import text2image_ldm
+    from videop2p_tpu.utils.tokenizers import WordTokenizer
+
+    cfg = UNet3DConfig.tiny()
+    model = UNet3DConditionModel(config=cfg)
+    x = jnp.zeros((1, 1, 8, 8, 4))
+    cond = jax.random.normal(jax.random.key(4), (2, 77, cfg.cross_attention_dim))
+    params = model.init(jax.random.key(5), x, jnp.asarray(0), cond[:1])
+    ctx = make_controller(
+        ["a cat", "a dog"], WordTokenizer(), num_steps=3,
+        is_replace_controller=True,
+        cross_replace_steps=0.8, self_replace_steps=0.5,
+    )
+
+    def vq_decode(z):
+        # stand-in VQ decoder: nearest-upsample latents to image space
+        img = jnp.repeat(jnp.repeat(z[..., :3], 2, axis=1), 2, axis=2)
+        return jnp.tanh(img)
+
+    images, latent = text2image_ldm(
+        make_unet_fn(model), params, DDIMScheduler.create_sd(), vq_decode,
+        cond, jnp.zeros((77, cfg.cross_attention_dim)),
+        ctx=ctx, num_inference_steps=3,
+        height=16, width=16, vae_scale_factor=2,
+        key=jax.random.key(6),
+    )
+    assert images.shape == (2, 16, 16, 3) and images.dtype == np.uint8
+    assert latent.shape == (1, 8, 8, 4)

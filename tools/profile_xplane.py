@@ -24,6 +24,14 @@ os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
 def iter_device_events(trace_dir: str, line_name: str = "XLA Ops"):
     """Yield ``(op_name, duration_ps)`` for every ``line_name`` line event on
     a device plane of every xplane proto under ``trace_dir``."""
+    for name, _, dur in iter_device_event_windows(trace_dir, line_name):
+        yield name, dur
+
+
+def iter_device_event_windows(trace_dir: str, line_name: str = "XLA Ops"):
+    """Yield ``(op_name, start_ps, duration_ps)`` for every ``line_name``
+    line event on a device plane, with starts on the trace's absolute
+    timeline (line timestamp + event offset)."""
     from tensorflow.tsl.profiler.protobuf import xplane_pb2
 
     for path in glob.glob(
@@ -39,8 +47,13 @@ def iter_device_events(trace_dir: str, line_name: str = "XLA Ops"):
             for line in plane.lines:
                 if line.name != line_name:
                     continue
+                base_ps = line.timestamp_ns * 1000
                 for ev in line.events:
-                    yield ev_names.get(ev.metadata_id, "?"), ev.duration_ps
+                    yield (
+                        ev_names.get(ev.metadata_id, "?"),
+                        base_ps + ev.offset_ps,
+                        ev.duration_ps,
+                    )
 
 
 def module_device_seconds(trace_dir: str) -> float:
@@ -58,6 +71,22 @@ def module_device_seconds(trace_dir: str) -> float:
     return sum(
         ps for _, ps in iter_device_events(trace_dir, "XLA Modules")
     ) / 1e12
+
+
+def module_device_span_seconds(trace_dir: str) -> float:
+    """Envelope span (first program start → last program end, seconds) of the
+    "XLA Modules" events. With async dispatch several programs can overlap on
+    device, so the summed durations (:func:`module_device_seconds`) can
+    EXCEED true wall-clock; the span cannot, making it the honest reading
+    when the host-side wall-clock is untrusted. Returns 0.0 when the trace
+    recorded no module events."""
+    starts_ends = [
+        (start, start + dur)
+        for _, start, dur in iter_device_event_windows(trace_dir, "XLA Modules")
+    ]
+    if not starts_ends:
+        return 0.0
+    return (max(e for _, e in starts_ends) - min(s for s, _ in starts_ends)) / 1e12
 
 
 def _op_family(name: str) -> str:

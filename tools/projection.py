@@ -31,7 +31,7 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 # SD-1.5 UNet attention sites at 512² (64×64 latents): (N_spatial, channels,
 # heads, head_dim, count) per level — 2 transformer layers per down level,
@@ -73,8 +73,8 @@ def project(
     frames: int = 8,
     sp: int = 4,
     ici_gbps: float = 100.0,
-    shard_inv_s: float = None,
-    shard_edit_s: float = None,
+    shard_inv_s: Optional[float] = None,
+    shard_edit_s: Optional[float] = None,
 ) -> Dict:
     """Project the 4-chip fast-edit wall-clock from measured single-chip
     phase times. Returns the projection plus its full evidence.
@@ -93,8 +93,11 @@ def project(
     edit_mb = sum(r["total_mb_per_chip_per_step"] for r in t_edit)
     coll_inv = inv_mb * 1e6 / (ici_gbps * 1e9) * steps
     coll_edit = edit_mb * 1e6 / (ici_gbps * 1e9) * steps
-    proj_inv = (shard_inv_s if shard_inv_s else inv_s / sp) + coll_inv
-    proj_edit = (shard_edit_s if shard_edit_s else edit_s / sp) + coll_edit
+    # "is not None": a legitimate 0.0 shard reading must not silently fall
+    # back to linear scaling
+    use_shard = shard_inv_s is not None and shard_edit_s is not None
+    proj_inv = (shard_inv_s if use_shard else inv_s / sp) + coll_inv
+    proj_edit = (shard_edit_s if use_shard else edit_s / sp) + coll_edit
     total = proj_inv + proj_edit
     return {
         "projected_v5e4_s": round(total, 2),
@@ -105,7 +108,7 @@ def project(
             "overlap": "none (conservative)",
             "compute_scaling": (
                 "measured: single-chip F/sp-frame phases stand in for the "
-                "per-chip shard" if shard_inv_s and shard_edit_s
+                "per-chip shard" if use_shard
                 else "linear in sp (per-frame ops shard cleanly; "
                      "tests/test_parallel.py proves sharded==unsharded)"),
         },

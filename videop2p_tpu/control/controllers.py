@@ -187,10 +187,11 @@ def make_spatial_replace_controller(
 # --------------------------------------------------------------------- #
 
 
-def _edit_cross(probs: jax.Array, ctx: ControlContext, step_index: jax.Array) -> jax.Array:
-    """probs: (P, F, H, Q, W) conditional-half cross-attention probabilities."""
-    base, repl = probs[0], probs[1:]  # (F,H,Q,W), (E,F,H,Q,W)
-
+def _edit_cross(
+    base: jax.Array, repl: jax.Array, ctx: ControlContext, step_index: jax.Array
+) -> jax.Array:
+    """base: (F,H,Q,W) source-stream cross maps; repl: (E,F,H,Q,W) edit
+    streams. Returns the edited replacement streams (E,F,H,Q,W)."""
     if ctx.kind == "replace":
         new = jnp.einsum("fhqw,ewn->efhqn", base, ctx.replace_mapper)
     elif ctx.kind == "refine":
@@ -205,22 +206,22 @@ def _edit_cross(probs: jax.Array, ctx: ControlContext, step_index: jax.Array) ->
 
     # time gate: (E, 1, 1, W) → (E, 1, 1, 1, W)
     alpha_words = ctx.cross_replace_alpha[step_index][:, :, :, None, :]
-    out = new * alpha_words + (1.0 - alpha_words) * repl
-    return jnp.concatenate([base[None], out], axis=0)
+    return new * alpha_words + (1.0 - alpha_words) * repl
 
 
-def _edit_temporal(probs: jax.Array, ctx: ControlContext, step_index: jax.Array) -> jax.Array:
-    """probs: (P, D, H, F, F) conditional-half temporal attention probabilities.
+def _edit_temporal(
+    base: jax.Array, repl: jax.Array, ctx: ControlContext, step_index: jax.Array
+) -> jax.Array:
+    """base: (D,H,F,F) source-stream temporal maps; repl: (E,D,H,F,F) edit
+    streams. Returns the edited replacement streams.
 
     Frame counts are always ≤ 32² so the reference's query-size guard
     (run_videop2p.py:294) is unconditionally true.
     """
     lo, hi = ctx.self_replace_range
     active = jnp.logical_and(step_index >= lo, step_index < hi)
-    base, repl = probs[0], probs[1:]
     broadcast = jnp.broadcast_to(base[None], repl.shape)
-    out = jnp.where(active, broadcast, repl)
-    return jnp.concatenate([base[None], out], axis=0)
+    return jnp.where(active, broadcast, repl)
 
 
 def control_attention(
@@ -231,6 +232,7 @@ def control_attention(
     step_index: jax.Array,
     video_length: int,
     num_uncond: int = -1,
+    base_map: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Apply the edit to full-batch attention probabilities.
 
@@ -241,20 +243,27 @@ def control_attention(
     Only the conditional streams are edited (run_videop2p.py:217-218). The
     default U = P is the reference's CFG batch; fast mode drops the source
     stream's unused uncond (U = P−1), and cond-only forwards pass U = 0.
+
+    ``base_map``: cached-source mode — the source stream is NOT in the batch
+    (cond streams are the P−1 edits only) and its maps for this site/step come
+    from this array instead: (F, H, Q, W) for cross sites, (D, H, F, F) for
+    temporal sites (captured during DDIM inversion; see
+    pipelines.ddim_inversion_captured).
     """
     if ctx is None or ctx.kind == "empty":
         return probs
     P = ctx.num_prompts
     U = ctx.num_prompts if num_uncond < 0 else num_uncond
+    ncond = P if base_map is None else P - 1
     B, H, Q, K = probs.shape
-    if B % (U + P):
+    if B % (U + ncond):
         raise ValueError(
-            f"attention batch {B} does not factor into {U} uncond + {P} cond streams"
+            f"attention batch {B} does not factor into {U} uncond + {ncond} cond streams"
         )
-    inner = B // (U + P)  # F for cross sites, D (=h·w) for temporal sites
+    inner = B // (U + ncond)  # F for cross sites, D (=h·w) for temporal sites
     if is_cross and inner != video_length:
         raise ValueError(
-            f"cross-attention batch {B} does not factor as ({U}+{P})·{video_length} "
+            f"cross-attention batch {B} does not factor as ({U}+{ncond})·{video_length} "
             "(uncond+cond streams × frames) — batch layout mismatch"
         )
     if not is_cross and (Q != video_length or K != video_length):
@@ -262,12 +271,22 @@ def control_attention(
             f"temporal attention maps must be ({video_length}×{video_length}), got ({Q}×{K})"
         )
 
-    split = probs.reshape(U + P, inner, H, Q, K)
+    split = probs.reshape(U + ncond, inner, H, Q, K)
     cond = split[U:]
-    if is_cross:
-        edited = _edit_cross(cond, ctx, step_index)
+    if base_map is None:
+        base, repl = cond[0], cond[1:]
     else:
-        # temporal layout folds spatial positions; move them next to heads
-        edited = _edit_temporal(cond, ctx, step_index)
+        if base_map.shape != (inner, H, Q, K):
+            raise ValueError(
+                f"cached base map shape {base_map.shape} does not match the "
+                f"site's per-stream probability shape {(inner, H, Q, K)}"
+            )
+        base, repl = base_map.astype(probs.dtype), cond
+    if is_cross:
+        edited = _edit_cross(base, repl, ctx, step_index)
+    else:
+        edited = _edit_temporal(base, repl, ctx, step_index)
+    if base_map is None:
+        edited = jnp.concatenate([base[None], edited], axis=0)
     out = jnp.concatenate([split[:U], edited], axis=0)
     return out.reshape(B, H, Q, K)

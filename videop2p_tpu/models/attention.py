@@ -55,12 +55,40 @@ class AttnControl(struct.PyTreeNode):
     reference's hidden ``cur_step``/``cur_att_layer`` counters
     (run_videop2p.py:212-224)."""
 
-    ctx: ControlContext
+    ctx: Optional[ControlContext]
     step_index: jax.Array  # () int32
     # uncond streams ahead of the ctx.num_prompts cond streams in the batch;
     # -1 → ctx.num_prompts (the symmetric CFG layout). Fast mode drops the
     # source stream's unused uncond forward (num_uncond = num_prompts − 1).
     num_uncond: int = struct.field(pytree_node=False, default=-1)
+    # capture mode (cached-source fast edit): sow the FULL per-head
+    # probabilities at every controlled site into the ``attn_base`` collection
+    # — used during DDIM inversion so the edit can replay the source stream's
+    # maps without re-running its forwards
+    capture: bool = struct.field(pytree_node=False, default=False)
+    # cached-source mode: nested {module-path: {"probs": map}} tree giving the
+    # source stream's maps for THIS step; the batch holds only the P−1 edit
+    # streams and each controlled site reads its base map here. A site type
+    # with an empty capture window is absent from the tree — its gate is
+    # inactive at every step, so the site skips the edit entirely (the
+    # ``cached_source`` flag below keeps the layout contract unambiguous
+    # even when BOTH windows are empty and the tree is None).
+    cached_base: Optional[dict] = None
+    cached_source: bool = struct.field(pytree_node=False, default=False)
+
+    def base_map_for(self, path) -> Optional[jax.Array]:
+        """Look up this site's cached source map by its flax module path."""
+        node = self.cached_base
+        if node is None:
+            return None
+        for name in path:
+            if not isinstance(node, dict) or name not in node:
+                return None
+            node = node[name]
+        leaf = node.get("probs") if isinstance(node, dict) else None
+        if isinstance(leaf, tuple):  # flax sow stacks values into a tuple
+            leaf = leaf[0]
+        return leaf
 
 
 def _split_heads(x: jax.Array, heads: int) -> jax.Array:
@@ -186,6 +214,11 @@ class ControlledAttention(nn.Module):
             # word-sum + site-mean (see control/local_blend.py).
             self.sow("attn_store", "maps", probs.mean(axis=1))
 
+        if control is not None and control.capture:
+            # cached-source capture (inversion pass): full per-head pre-edit
+            # probabilities, every controlled site — the edit's base maps
+            self.sow("attn_base", "probs", probs)
+
         if control is not None:
             if video_length is None:
                 if self.site != "temporal":
@@ -193,14 +226,24 @@ class ControlledAttention(nn.Module):
                     # spatial-token count, not the frame count — require it
                     raise ValueError("video_length is required at controlled cross sites")
                 video_length = x.shape[1]
-            probs = control_attention(
-                probs,
-                control.ctx,
-                is_cross=(self.site == "cross"),
-                step_index=control.step_index,
-                video_length=video_length,
-                num_uncond=control.num_uncond,
-            )
+            base_map = control.base_map_for(self.path)
+            if control.cached_source and base_map is None:
+                # cached-source batch (no source stream) at a site whose
+                # capture window is empty: the gate is inactive at every
+                # step, so the unedited probabilities are exactly right —
+                # and the live-layout reshape below would mis-factor the
+                # P−1-stream batch
+                pass
+            else:
+                probs = control_attention(
+                    probs,
+                    control.ctx,
+                    is_cross=(self.site == "cross"),
+                    step_index=control.step_index,
+                    video_length=video_length,
+                    num_uncond=control.num_uncond,
+                    base_map=base_map,
+                )
 
         out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
         out = _merge_heads(out)

@@ -239,6 +239,11 @@ def make_frame_attention_fn(
         raise ValueError(f"unknown frame attention impl: {impl!r}")
 
     def fn(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+        if q.ndim != 5:
+            raise ValueError(
+                "frame-attention kernels take q of shape (B, F, H, N, D); "
+                f"got rank-{q.ndim} {q.shape}"
+            )
         b, f, h, n, d = q.shape
         if n < min_large_tokens:
             return dense_frame_attention(q, k, v)

@@ -1,11 +1,18 @@
 """Latent-space pipelines: controlled sampling, DDIM inversion, null-text."""
 
-from videop2p_tpu.pipelines.inversion import ddim_inversion, null_text_optimization
+from videop2p_tpu.pipelines.cached import CachedSource
+from videop2p_tpu.pipelines.inversion import (
+    ddim_inversion,
+    ddim_inversion_captured,
+    null_text_optimization,
+)
 from videop2p_tpu.pipelines.sampling import edit_sample, make_unet_fn
 from videop2p_tpu.pipelines.stores import blend_maps_from_store, flatten_store
 
 __all__ = [
+    "CachedSource",
     "ddim_inversion",
+    "ddim_inversion_captured",
     "null_text_optimization",
     "edit_sample",
     "make_unet_fn",

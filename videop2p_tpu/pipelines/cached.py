@@ -1,0 +1,163 @@
+"""Cached-source fast editing: replay the source stream from inversion.
+
+The reference's fast mode keeps the source stream in the CFG batch and
+re-predicts its ε from the drifting latent every step
+(/root/reference/tuneavideo/pipelines/pipeline_tuneavideo.py:412-415) — one
+full UNet stream spent on an *approximate* replay of the DDIM inversion.
+Here the replay is free and exact: DDIM ``next_step``/``prev_step`` are
+linear in (x, ε) with identical coefficients, so the source latent at edit
+step *i* IS ``trajectory[N−i]`` — no forward needed. The edit batch drops
+from (P−1)+P to (P−1)+(P−1) streams (33 % fewer UNet streams at P=2).
+
+What the dropped stream used to provide, and where it comes from now:
+
+  * its ε — unnecessary: the latent path is read straight off the reversed
+    inversion trajectory (exact where the reference drifts);
+  * base attention maps for the controllers — captured during inversion
+    (``attn_base`` collection, full per-head probs) at the steps that need
+    them. The cross gate ``cross_replace_alpha[i]`` is zero past its window
+    and the temporal gate is a [lo, hi) step window
+    (run_videop2p.py:304-317) — outside the windows the edited output equals
+    the unedited edit-stream maps, so capturing ONLY the gated steps is
+    semantically exact and is what keeps the cache inside HBM (rabbit-jump:
+    ~3 GB vs ~13 GB for all 50 steps);
+  * its LocalBlend store contribution — captured per step as the already
+    head-meaned, blend-site-stacked tensor (tiny).
+
+One disclosed approximation: the captured maps come from the inversion
+forward at ``(trajectory[j], t_j)`` while a live source stream would compute
+them at ``(trajectory[j+1], t_j)`` — the same timestep, one trajectory
+position earlier. The latent replay itself is exact; only the controllers'
+*base maps* carry this one-position offset (they are semantic layout guides,
+and the reference's own fast mode feeds the controllers maps from a drifted
+latent).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+__all__ = [
+    "CachedSource",
+    "capture_windows",
+    "filter_site_tree",
+    "merge_site_trees",
+    "slice_site_tree",
+    "tree_bytes",
+]
+
+
+def capture_windows(ctx, num_steps: int) -> Tuple[int, Tuple[int, int]]:
+    """The gate rule that decides which inversion steps must capture maps:
+    cross base maps are only read while ANY word's ``cross_replace_alpha`` is
+    nonzero (a step prefix — conservative for per-word dict schedules), and
+    temporal base maps only inside the self-replace window. Returns
+    ``(cross_len, (self_lo, self_hi))``. Shared by the CLI, the bench and
+    the tests so the rule cannot drift between them."""
+    import numpy as np
+
+    cra = np.asarray(jax.device_get(ctx.cross_replace_alpha))[:num_steps]
+    active = (cra != 0).any(axis=tuple(range(1, cra.ndim)))
+    cross_len = int(active.nonzero()[0].max()) + 1 if active.any() else 0
+    return cross_len, ctx.self_replace_range
+
+
+def filter_site_tree(tree: Dict[str, Any], site_name: str) -> Dict[str, Any]:
+    """Keep only the subtrees whose path ends at a module named ``site_name``
+    (``"attn2"`` for cross sites, ``"attn_temp"`` for temporal sites)."""
+    out: Dict[str, Any] = {}
+    for k, v in tree.items():
+        if k == site_name:
+            out[k] = v
+        elif isinstance(v, dict):
+            sub = filter_site_tree(v, site_name)
+            if sub:
+                out[k] = sub
+    return out
+
+
+def merge_site_trees(a: Optional[Dict[str, Any]], b: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """Deep-merge two nested site trees with disjoint leaves."""
+    if not a:
+        return dict(b or {})
+    if not b:
+        return dict(a)
+    out = dict(a)
+    for k, v in b.items():
+        if k in out and isinstance(out[k], dict) and isinstance(v, dict):
+            out[k] = merge_site_trees(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def slice_site_tree(tree: Optional[Dict[str, Any]], index: jax.Array) -> Optional[Dict[str, Any]]:
+    """Index every leaf's leading (step-window) axis at a traced index."""
+    if not tree:
+        return None
+    return jax.tree.map(
+        lambda a: jax.lax.dynamic_index_in_dim(a, index, axis=0, keepdims=False), tree
+    )
+
+
+def tree_bytes(tree) -> int:
+    """Total bytes of the array (or ShapeDtypeStruct) leaves of a pytree."""
+    import math
+
+    return sum(
+        math.prod(leaf.shape) * leaf.dtype.itemsize
+        for leaf in jax.tree.leaves(tree)
+        if hasattr(leaf, "dtype") and hasattr(leaf, "shape")
+    )
+
+
+class CachedSource(struct.PyTreeNode):
+    """Everything the cached-source edit scan reads in place of a live source
+    stream. All step-indexed arrays are in EDIT-step order (the reverse of
+    the inversion walk that produced them).
+    """
+
+    # (num_steps+1, 1, F, h, w, C) — reversed trajectory: [i] is the source
+    # latent entering edit step i; [i+1] the latent after it; [-1] is x_0
+    src_latents: jax.Array
+    # nested {path: {"probs": (cross_len, F, H, Q, W)}} for attn2 sites,
+    # covering edit steps [0, cross_len); None/{} when no cross edit
+    cross_maps: Optional[Dict[str, Any]] = None
+    # nested {path: {"probs": (hi−lo, D, H, F, F)}} for attn_temp sites,
+    # covering edit steps [lo, hi); None/{} when no temporal edit
+    temporal_maps: Optional[Dict[str, Any]] = None
+    # (num_steps, 1, F, S, r, r, L) — the source stream's per-step LocalBlend
+    # store contribution; None when no blend is configured
+    blend_seq: Optional[jax.Array] = None
+
+    # step windows the maps cover (static)
+    cross_len: int = struct.field(pytree_node=False, default=0)
+    self_window: Tuple[int, int] = struct.field(pytree_node=False, default=(0, 0))
+
+    def base_tree_at(self, step_index: jax.Array) -> Optional[Dict[str, Any]]:
+        """Per-step base-map tree for :class:`AttnControl.cached_base`.
+
+        Outside a window the slice index clamps to the window edge — the
+        stale value is provably unused because the corresponding gate
+        (cross_replace_alpha / the self-replace window) multiplies it out.
+        """
+        cross = None
+        if self.cross_maps and self.cross_len > 0:
+            idx = jnp.clip(step_index, 0, self.cross_len - 1)
+            cross = slice_site_tree(self.cross_maps, idx)
+        temporal = None
+        lo, hi = self.self_window
+        if self.temporal_maps and hi > lo:
+            idx = jnp.clip(step_index - lo, 0, hi - lo - 1)
+            temporal = slice_site_tree(self.temporal_maps, idx)
+        if cross is None and temporal is None:
+            return None
+        return merge_site_trees(cross, temporal)
+
+    @property
+    def num_steps(self) -> int:
+        return self.src_latents.shape[0] - 1

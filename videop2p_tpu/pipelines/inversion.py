@@ -30,9 +30,12 @@ import optax
 
 from videop2p_tpu.core.ddim import DDIMScheduler
 from videop2p_tpu.core.noise import DependentNoiseSampler
+from videop2p_tpu.models.attention import AttnControl
+from videop2p_tpu.pipelines.cached import CachedSource, filter_site_tree
 from videop2p_tpu.pipelines.sampling import UNetFn
+from videop2p_tpu.pipelines.stores import blend_maps_from_store
 
-__all__ = ["ddim_inversion", "null_text_optimization"]
+__all__ = ["ddim_inversion", "ddim_inversion_captured", "null_text_optimization"]
 
 # jitted chunk scans for the outer_chunk path, keyed by the statics their
 # closures bake in (runtime arrays enter as jit inputs); bounded FIFO
@@ -107,6 +110,128 @@ def ddim_inversion(
     return full
 
 
+def ddim_inversion_captured(
+    unet_fn: UNetFn,
+    params,
+    scheduler: DDIMScheduler,
+    latents: jax.Array,
+    cond_embedding: jax.Array,
+    *,
+    num_inference_steps: int = 50,
+    cross_len: int = 0,
+    self_window: Tuple[int, int] = (0, 0),
+    capture_blend: bool = False,
+    blend_res: Optional[Tuple[int, int]] = None,
+    dependent_weight: float = 0.0,
+    dependent_sampler: Optional[DependentNoiseSampler] = None,
+    key: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, CachedSource]:
+    """DDIM inversion that also captures everything a cached-source edit
+    needs (see :mod:`videop2p_tpu.pipelines.cached` for the design).
+
+    Same walk as :func:`ddim_inversion`, but split into segments so that the
+    full per-head controlled-site probabilities are stacked ONLY for the
+    inversion steps whose maps the edit's gates will actually read:
+
+      * cross maps for edit steps [0, ``cross_len``) — inversion steps
+        [N−cross_len, N);
+      * temporal maps for edit steps [lo, hi) = ``self_window`` — inversion
+        steps [N−hi, N−lo);
+      * per-step LocalBlend store contributions for every step when
+        ``capture_blend`` (head-meaned and blend-site-stacked first — tiny).
+
+    Edit step *i* reads the maps captured at inversion step ``N−1−i``: the
+    same timestep, with the latent one trajectory position earlier than a
+    live source stream would use (the disclosed approximation; the latent
+    replay itself is exact). Returns ``(trajectory, CachedSource)``.
+    """
+    if dependent_weight > 0.0 and dependent_sampler is None:
+        raise ValueError("dependent_weight > 0 requires dependent_sampler")
+    N = num_inference_steps
+    lo, hi = self_window
+    if not (0 <= lo <= hi <= N):
+        raise ValueError(f"self_window {self_window} outside [0, {N}]")
+    if not (0 <= cross_len <= N):
+        raise ValueError(f"cross_len {cross_len} outside [0, {N}]")
+    latents = latents.astype(jnp.float32)
+    video_length = latents.shape[1]
+    latent_hw = latents.shape[2:4]
+    text_len = cond_embedding.shape[-2]
+    timesteps = jnp.asarray(scheduler.timesteps(N)[::-1].copy())
+    if key is None:
+        key = jax.random.key(0)
+
+    def run_segment(latent, key, ts, want_cross, want_temporal):
+        capture = want_cross or want_temporal
+
+        def body(carry, t):
+            latent, key = carry
+            control = (
+                AttnControl(ctx=None, step_index=jnp.asarray(0, jnp.int32), capture=True)
+                if capture
+                else None
+            )
+            eps, store = unet_fn(params, latent, t, cond_embedding, control)
+            if dependent_weight > 0.0:
+                key, sub = jax.random.split(key)
+                ar_noise = dependent_sampler.sample_like(sub, eps)
+                eps = (1.0 - dependent_weight) * eps + dependent_weight * ar_noise
+            latent = scheduler.next_step(eps, t, latent, N)
+            ys = {"latent": latent}
+            if capture_blend:
+                ys["blend"] = blend_maps_from_store(
+                    store,
+                    latent_hw=latent_hw,
+                    video_length=video_length,
+                    num_prompts=1,
+                    text_len=text_len,
+                    blend_res=blend_res,
+                    num_uncond=0,
+                )
+            if want_cross:
+                ys["cross"] = filter_site_tree(store["attn_base"], "attn2")
+            if want_temporal:
+                ys["temporal"] = filter_site_tree(store["attn_base"], "attn_temp")
+            return (latent, key), ys
+
+        return jax.lax.scan(body, (latent, key), ts)
+
+    # segment the walk at the capture-window edges (inversion-step space):
+    # cross maps live in [N−cross_len, N), temporal in [N−hi, N−lo)
+    bounds = sorted({0, N - hi, N - lo, N - cross_len, N})
+    carry = (latents, key)
+    lat_pieces, blend_pieces, cross_pieces, temporal_pieces = [], [], [], []
+    for s, e in zip(bounds[:-1], bounds[1:]):
+        want_cross = s >= N - cross_len
+        want_temporal = s >= N - hi and e <= N - lo
+        carry, ys = run_segment(*carry, timesteps[s:e], want_cross, want_temporal)
+        lat_pieces.append(ys["latent"])
+        if capture_blend:
+            blend_pieces.append(ys["blend"])
+        if want_cross:
+            cross_pieces.append(ys["cross"])
+        if want_temporal:
+            temporal_pieces.append(ys["temporal"])
+
+    trajectory = jnp.concatenate([latents[None]] + lat_pieces, axis=0)
+
+    def stack_reversed(pieces):
+        # inversion order → edit order (edit step i ↔ inversion step N−1−i)
+        if not pieces:
+            return None
+        return jax.tree.map(lambda *xs: jnp.flip(jnp.concatenate(xs, axis=0), axis=0), *pieces)
+
+    cached = CachedSource(
+        src_latents=jnp.flip(trajectory, axis=0),
+        cross_maps=stack_reversed(cross_pieces),
+        temporal_maps=stack_reversed(temporal_pieces),
+        blend_seq=stack_reversed(blend_pieces) if capture_blend else None,
+        cross_len=cross_len,
+        self_window=(lo, hi),
+    )
+    return trajectory, cached
+
+
 def null_text_optimization(
     unet_fn: UNetFn,
     params,
@@ -123,9 +248,16 @@ def null_text_optimization(
     dependent_sampler: Optional[DependentNoiseSampler] = None,
     key: Optional[jax.Array] = None,
     outer_chunk: Optional[int] = None,
+    early_stop: bool = True,
 ) -> jax.Array:
     """Optimize a per-step unconditional embedding that makes CFG denoising
     replay the recorded inversion trajectory (run_videop2p.py:580-612).
+
+    ``early_stop=False`` runs exactly ``num_inner_steps`` inner iterations
+    per outer step (no ``loss < ε + i·2e-5`` break): the work becomes
+    weight-independent, giving a stable wall-clock for benchmarking — the
+    reference-faithful early-stopped run varies 157–418 s with the random
+    early-stop point (run_videop2p.py:603).
 
     ``trajectory``: (num_steps+1, B, F, h, w, C) from :func:`ddim_inversion`;
     ``cond_embedding`` / ``uncond_embedding``: (B, L, D).
@@ -183,6 +315,8 @@ def null_text_optimization(
 
         def inner_cond(state):
             _, _, last_loss, j, _ = state
+            if not early_stop:
+                return j < num_inner_steps
             return jnp.logical_and(j < num_inner_steps, last_loss >= thresh)
 
         def inner_body(state):
@@ -235,6 +369,7 @@ def null_text_optimization(
     cache_key = (
         unet_fn, id(scheduler), id(dependent_sampler), float(guidance_scale),
         int(num_inner_steps), int(num_inference_steps), float(dependent_weight),
+        bool(early_stop),
     )
     chunk_scan = _CHUNK_SCAN_CACHE.get(cache_key)
     if chunk_scan is None:

@@ -34,6 +34,7 @@ from videop2p_tpu.control.local_blend import local_blend
 from videop2p_tpu.core.ddim import DDIMScheduler
 from videop2p_tpu.core.noise import DependentNoiseSampler
 from videop2p_tpu.models.attention import AttnControl
+from videop2p_tpu.pipelines.cached import CachedSource
 from videop2p_tpu.pipelines.stores import blend_maps_from_store
 
 __all__ = ["edit_sample", "make_unet_fn"]
@@ -46,12 +47,14 @@ def make_unet_fn(model) -> UNetFn:
     """Adapter from a linen UNet module to the pipeline's callable contract."""
 
     def fn(params, sample, t, text, control=None):
-        # init() also returns an "attn_store" collection (sow runs during
-        # init); passing it back into apply would make sow append a second
-        # entry per site — keep only the parameter collections.
-        variables = {k: v for k, v in params.items() if k != "attn_store"}
+        # init() also returns sown collections (sow runs during init);
+        # passing them back into apply would make sow append a second entry
+        # per site — keep only the parameter collections.
+        variables = {
+            k: v for k, v in params.items() if k not in ("attn_store", "attn_base")
+        }
         out, store = model.apply(
-            variables, sample, t, text, control, mutable=["attn_store"]
+            variables, sample, t, text, control, mutable=["attn_store", "attn_base"]
         )
         return out, store
 
@@ -75,6 +78,7 @@ def edit_sample(
     dependent_sampler: Optional[DependentNoiseSampler] = None,
     blend_res: Optional[Tuple[int, int]] = None,
     null_uncond_embeddings: Optional[jax.Array] = None,
+    cached_source: Optional[CachedSource] = None,
 ) -> jax.Array:
     """Run the controlled denoise loop; returns final latents (P, F, h, w, C).
 
@@ -90,6 +94,11 @@ def edit_sample(
     uncond (the reference's ``text_embeddings[0] = uncond_embeddings_pre[i]``,
     pipeline_tuneavideo.py:399-403).
     ``source_uses_cfg=False`` is the --fast mode source branch.
+    ``cached_source``: cached-source fast mode — the source stream is dropped
+    from the batch entirely; its latents replay the inversion trajectory
+    exactly and the controllers read its attention maps from the capture
+    (see :mod:`videop2p_tpu.pipelines.cached`). Requires
+    ``source_uses_cfg=False``, ``eta=0`` and no null-text embeddings.
 
     Per-frame ("multi") conditioning (pipeline_tuneavideo.py:366-367,399-402):
     pass ``cond_embeddings`` as (P, F, L, D); ``uncond_embeddings`` stays
@@ -129,6 +138,34 @@ def edit_sample(
         uncond_embeddings = jnp.broadcast_to(
             uncond_embeddings[None], (video_length,) + uncond_embeddings.shape
         )
+
+    if cached_source is not None:
+        if source_uses_cfg:
+            raise ValueError("cached_source requires fast mode (source_uses_cfg=False)")
+        if null_uncond_embeddings is not None:
+            raise ValueError(
+                "cached_source replays the source exactly — null-text "
+                "embeddings have nothing left to correct and are not injected"
+            )
+        if eta > 0:
+            raise ValueError(
+                "cached_source requires eta=0: η-variance noise would make the "
+                "live source stream stochastic while the cached replay is "
+                "deterministic"
+            )
+        if cached_source.num_steps != num_inference_steps:
+            raise ValueError(
+                f"cached trajectory covers {cached_source.num_steps} steps, "
+                f"edit runs {num_inference_steps}"
+            )
+        return _edit_sample_cached(
+            unet_fn, params, scheduler, latents, cond_embeddings,
+            uncond_embeddings, cached_source,
+            num_inference_steps=num_inference_steps,
+            guidance_scale=guidance_scale, ctx=ctx,
+            blend_res=blend_res, key=key,
+        )
+
     # the source stream's per-step uncond: the null-text sequence when given,
     # else the raw uncond every step
     if null_uncond_embeddings is not None:
@@ -263,3 +300,154 @@ def edit_sample(
     xs = (timesteps, jnp.arange(num_inference_steps), uncond0_seq)
     (latents, _, _), _ = jax.lax.scan(body, (latents, maps_sum, key), xs)
     return latents
+
+
+def _edit_sample_cached(
+    unet_fn: UNetFn,
+    params,
+    scheduler: DDIMScheduler,
+    latents: jax.Array,
+    cond_embeddings: jax.Array,
+    uncond_embeddings: jax.Array,
+    cached: CachedSource,
+    *,
+    num_inference_steps: int,
+    guidance_scale: float,
+    ctx: Optional[ControlContext],
+    blend_res: Optional[Tuple[int, int]],
+    key: Optional[jax.Array],
+) -> jax.Array:
+    """The cached-source denoise loop: only the P−1 edit streams run the
+    UNet; the source stream is read off the reversed inversion trajectory
+    (exact replay) and its controller inputs come from the capture
+    (:mod:`videop2p_tpu.pipelines.cached`).
+
+    Inputs arrive normalized by :func:`edit_sample` (latents broadcast to
+    (P, F, h, w, C), uncond as (L, D) — or per-frame in multi mode).
+    """
+    P = cond_embeddings.shape[0]
+    E = P - 1  # edit streams
+    U = E  # their uncond streams
+    if E < 1:
+        raise ValueError("cached_source needs at least one edit prompt")
+    video_length = latents.shape[1]
+    latent_hw = latents.shape[2:4]
+    text_len = cond_embeddings.shape[-2]
+    timesteps = jnp.asarray(scheduler.timesteps(num_inference_steps))
+    if key is None:
+        key = jax.random.key(0)
+
+    edit_latents = latents[1:]  # (E, F, h, w, C), fp32 from the caller
+    cond_edit = cond_embeddings[1:]
+    text = jnp.concatenate(
+        [jnp.broadcast_to(uncond_embeddings[None], (E,) + uncond_embeddings.shape), cond_edit],
+        axis=0,
+    )
+
+    if ctx is not None and ctx.kind != "empty":
+        # a non-empty gate window with no captured maps would silently skip
+        # the edit at every site of that type — fail loudly instead
+        lo, hi = cached.self_window
+        if cached.cross_len > 0 and not cached.cross_maps:
+            raise ValueError(
+                f"capture declares a {cached.cross_len}-step cross window but "
+                "has no cross maps"
+            )
+        if hi > lo and not cached.temporal_maps:
+            raise ValueError(
+                f"capture declares self window {cached.self_window} but has "
+                "no temporal maps"
+            )
+
+    use_blend = ctx is not None and ctx.blend is not None
+    if use_blend and cached.blend_seq is None:
+        raise ValueError(
+            "LocalBlend is configured but the capture has no blend_seq — run "
+            "ddim_inversion_captured(capture_blend=True)"
+        )
+    # src_seq[i] = source latent AFTER edit step i (= trajectory[N−i−1])
+    src_seq = cached.src_latents[1:]
+
+    maps_sum = None
+    if use_blend:
+        control0 = AttnControl(
+            ctx=ctx, step_index=jnp.asarray(0), num_uncond=U,
+            cached_base=cached.base_tree_at(jnp.asarray(0)),
+            cached_source=True,
+        )
+        _, store_shape = jax.eval_shape(
+            unet_fn,
+            params,
+            jnp.concatenate([edit_latents, edit_latents], axis=0),
+            timesteps[0],
+            text,
+            control0,
+        )
+        edit_maps_shape = jax.eval_shape(
+            lambda s: blend_maps_from_store(
+                s,
+                latent_hw=latent_hw,
+                video_length=video_length,
+                num_prompts=E,
+                text_len=text_len,
+                blend_res=blend_res,
+                num_uncond=U,
+            ),
+            store_shape,
+        )
+        maps_sum = jnp.zeros(
+            (1 + E,) + edit_maps_shape.shape[1:], edit_maps_shape.dtype
+        )
+
+    def body(carry, xs):
+        edit_latents, maps_sum = carry
+        t, i, src_after, blend_src = xs
+        latent_in = jnp.concatenate([edit_latents, edit_latents], axis=0)
+        control = (
+            AttnControl(
+                ctx=ctx, step_index=i, num_uncond=U,
+                cached_base=cached.base_tree_at(i),
+                cached_source=True,
+            )
+            if ctx is not None
+            else None
+        )
+        eps_all, store = unet_fn(params, latent_in, t, text, control)
+        eps_uncond, eps_text = eps_all[:E], eps_all[E:]
+        eps = eps_uncond + guidance_scale * (eps_text - eps_uncond)
+        edit_latents, _ = scheduler.step(
+            eps, t, edit_latents, num_inference_steps, eta=0.0, variance_noise=None
+        )
+
+        if use_blend:
+            edit_maps = blend_maps_from_store(
+                store,
+                latent_hw=latent_hw,
+                video_length=video_length,
+                num_prompts=E,
+                text_len=text_len,
+                blend_res=blend_res,
+                num_uncond=U,
+            )
+            maps_sum = maps_sum + jnp.concatenate([blend_src, edit_maps], axis=0)
+            full = jnp.concatenate([src_after, edit_latents], axis=0)
+            full = local_blend(full, maps_sum, ctx.blend, i)
+            edit_latents = full[1:]
+        if ctx is not None and ctx.spatial_replace_until > 0:
+            active = i < ctx.spatial_replace_until
+            edit_latents = jnp.where(
+                active,
+                jnp.broadcast_to(src_after, edit_latents.shape),
+                edit_latents,
+            )
+        return (edit_latents, maps_sum), None
+
+    blend_xs = (
+        cached.blend_seq
+        if cached.blend_seq is not None
+        else jnp.zeros((num_inference_steps, 0))
+    )
+    xs = (timesteps, jnp.arange(num_inference_steps), src_seq, blend_xs)
+    (edit_latents, _), _ = jax.lax.scan(body, (edit_latents, maps_sum), xs)
+    # stream 0 = the exact inversion reconstruction (trajectory[0] = x_0)
+    return jnp.concatenate([cached.src_latents[-1], edit_latents], axis=0)

@@ -34,7 +34,14 @@ def _select_blend_leaves(
     q_blend = blend_res[0] * blend_res[1]
     out = []
     for path, leaf in flatten_store(store):
-        if "attn2" in path and leaf.shape[-1] == text_len and leaf.shape[-2] == q_blend:
+        # head-mean store leaves are 3-d (B·F, Q, L); full-head capture leaves
+        # in the attn_base collection are 4-d — exclude those
+        if (
+            "attn2" in path
+            and leaf.ndim == 3
+            and leaf.shape[-1] == text_len
+            and leaf.shape[-2] == q_blend
+        ):
             out.append(leaf)
     return out
 
@@ -43,7 +50,7 @@ def _cross_site_sizes(store: Dict[str, Any], text_len: int) -> List[int]:
     return sorted({
         leaf.shape[-2]
         for path, leaf in flatten_store(store)
-        if "attn2" in path and leaf.shape[-1] == text_len
+        if "attn2" in path and leaf.ndim == 3 and leaf.shape[-1] == text_len
     })
 
 

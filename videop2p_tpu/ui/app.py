@@ -80,7 +80,8 @@ def build_app():
             seed=int(seed), out_path=os.path.join(exp_dir, "sample.gif"),
         )
 
-    with gr.Blocks(title="Video-P2P (TPU)") as demo:
+    # the reference's stylesheet (gradio_utils/style.css: centered h1)
+    with gr.Blocks(title="Video-P2P (TPU)", css="h1 { text-align: center; }") as demo:
         gr.Markdown("# Video-P2P — TPU-native video editing with cross-attention control")
         with gr.Tab("Train"):
             video_dir = gr.Textbox(label="Training video (mp4 or frame dir)")

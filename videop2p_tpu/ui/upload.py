@@ -14,7 +14,13 @@ from typing import Callable, Optional
 
 from videop2p_tpu.ui.trainer import _slugify
 
-__all__ = ["UploadTarget", "MODEL_LIBRARY_ORG_NAME", "Uploader", "ModelUploader"]
+__all__ = [
+    "UploadTarget",
+    "MODEL_LIBRARY_ORG_NAME",
+    "SAMPLE_MODEL_REPO",
+    "Uploader",
+    "ModelUploader",
+]
 
 
 class UploadTarget(enum.Enum):
@@ -23,6 +29,8 @@ class UploadTarget(enum.Enum):
 
 
 MODEL_LIBRARY_ORG_NAME = "Video-P2P-library"
+# the hosted demo's sample checkpoint (gradio_utils/constants.py:10)
+SAMPLE_MODEL_REPO = "Video-P2P-library/a-man-is-surfing"
 
 
 def _default_api_factory(token: Optional[str]):

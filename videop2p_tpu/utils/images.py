@@ -22,6 +22,7 @@ __all__ = [
     "latent2image",
     "latent2image_video",
     "init_latent",
+    "text2image_ldm",
     "text2image_stable",
 ]
 
@@ -147,6 +148,58 @@ def init_latent(
         latent, (batch_size,) + tuple(latent.shape[1:])
     )
     return latent, latents
+
+
+def text2image_ldm(
+    unet_fn,
+    params,
+    scheduler,
+    vq_decode_fn,
+    cond_embeddings,
+    uncond_embeddings,
+    *,
+    ctx=None,
+    num_inference_steps: int = 50,
+    guidance_scale: float = 7.0,
+    height: int = 256,
+    width: int = 256,
+    vae_scale_factor: int = 8,
+    channels: int = 4,
+    latent=None,
+    key=None,
+) -> Tuple[np.ndarray, "np.ndarray"]:
+    """Controlled text→image sampling for BERT/VQ-VAE latent-diffusion
+    checkpoints (the reference's legacy ``text2image_ldm``,
+    ptp_utils.py:112-139): 256² working point, guidance 7.0, and a VQ decoder
+    in place of the KL VAE. The text side is the caller's: the reference
+    embeds with ``model.bert``; here the precomputed ``cond_embeddings``
+    (P, L, D) / ``uncond_embeddings`` (L, D) come in, and ``vq_decode_fn``
+    maps latents (B, h, w, C) → images in [-1, 1]. The denoise loop is the
+    same shared ``edit_sample`` scan the stable variant uses.
+    """
+    import jax.numpy as jnp
+
+    from videop2p_tpu.pipelines.sampling import edit_sample
+    from videop2p_tpu.utils.video_io import to_uint8
+
+    batch = cond_embeddings.shape[0]
+    latent, latents = init_latent(
+        latent, batch, height=height, width=width, channels=channels,
+        vae_scale_factor=vae_scale_factor, key=key,
+    )
+    out = edit_sample(
+        unet_fn,
+        params,
+        scheduler,
+        latents[:, None],  # (P, F=1, h, w, C)
+        jnp.asarray(cond_embeddings),
+        jnp.asarray(uncond_embeddings),
+        num_inference_steps=num_inference_steps,
+        guidance_scale=guidance_scale,
+        ctx=ctx,
+    )
+    img = vq_decode_fn(out[:, 0])
+    return to_uint8(np.asarray(img) / 2 + 0.5), latent
 
 
 def text2image_stable(

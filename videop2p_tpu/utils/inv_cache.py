@@ -1,0 +1,134 @@
+"""Persist and reuse DDIM-inversion products across Stage-2 invocations.
+
+The reference carries (commented-out) save/load of the optimized uncond
+embeddings (/root/reference/run_videop2p.py:663-673) and Stage-1 persists
+``inv_latents/ddim_latent-*.pt`` (run_tuning.py:354-361) precisely so a
+clip's expensive inversion products can be reused. Here that intent is
+finished: the full inversion trajectory (~26 MB at SD scale — x_T is its
+last entry) and the null-text embeddings are stored under the results dir,
+keyed by everything that determines them (clip, source prompt, step count,
+geometry, dependent-noise settings, checkpoint identity). A repeat edit of
+the same clip — e.g. iterating on the edit prompt — skips DDIM inversion
+and the 157–418 s null-text optimization entirely.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "content_fingerprint",
+    "inversion_cache_key",
+    "load_inversion",
+    "save_inversion",
+]
+
+
+def content_fingerprint(path: str) -> str:
+    """Digest of a file tree's (relpath, size, mtime_ns) triples — a cheap
+    content identity for a checkpoint dir or a clip. Re-tuning a checkpoint
+    in place or swapping a clip's frames changes the fingerprint, so cache
+    keys built on it miss instead of silently reusing stale products.
+    Missing paths fingerprint as such (random-init smoke runs)."""
+    entries = []
+    if os.path.isfile(path):
+        st = os.stat(path)
+        entries.append((os.path.basename(path), st.st_size, st.st_mtime_ns))
+    elif os.path.isdir(path):
+        for root, dirs, files in os.walk(path):
+            # Stage-2 writes its results (GIFs, this cache) INSIDE the
+            # checkpoint dir — a run's own outputs must not churn the key
+            dirs[:] = [
+                d for d in dirs
+                if not d.startswith("results_dp") and d != "inv_cache"
+            ]
+            for f in sorted(files):
+                p = os.path.join(root, f)
+                try:
+                    st = os.stat(p)
+                except OSError:
+                    continue
+                entries.append(
+                    (os.path.relpath(p, path), st.st_size, st.st_mtime_ns)
+                )
+    else:
+        entries.append(("<missing>", 0, 0))
+    blob = json.dumps(sorted(entries))
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def inversion_cache_key(**determinants) -> str:
+    """Stable digest of everything that determines the inversion products.
+
+    Callers pass the clip path, source prompt, num steps, width/frames,
+    dependent-noise settings, seed and a checkpoint identity; any change
+    produces a fresh key (stale hits are impossible by construction).
+    """
+    blob = json.dumps(
+        {k: determinants[k] for k in sorted(determinants)}, sort_keys=True, default=str
+    )
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def _cache_dir(results_dir: str, key: str) -> str:
+    return os.path.join(results_dir, "inv_cache", key)
+
+
+def load_inversion(
+    results_dir: str, key: str, *, want_null: bool, null_tag: str = ""
+) -> Optional[Tuple[np.ndarray, Optional[np.ndarray]]]:
+    """Return (trajectory, null_embeddings-or-None) on a hit, else None.
+
+    ``want_null``: full (official) mode needs the null-text embeddings too —
+    a trajectory-only entry (saved by a --fast run) is then a miss for the
+    null part but still skips the inversion walk. ``null_tag`` distinguishes
+    null-optimization settings (e.g. inner-step count) sharing a trajectory.
+    """
+    d = _cache_dir(results_dir, key)
+    traj_path = os.path.join(d, "trajectory.npy")
+    if not os.path.exists(traj_path):
+        return None
+    trajectory = np.load(traj_path)
+    null_path = os.path.join(d, f"null_embeddings{null_tag}.npy")
+    null = np.load(null_path) if want_null and os.path.exists(null_path) else None
+    return trajectory, null
+
+
+def save_inversion(
+    results_dir: str,
+    key: str,
+    trajectory=None,
+    null_embeddings=None,
+    *,
+    null_tag: str = "",
+    meta: Optional[Dict] = None,
+) -> str:
+    """Persist the trajectory (+ optional null embeddings) atomically; null
+    embeddings may be added later to an existing trajectory entry (pass
+    ``trajectory=None`` then — callers should not re-materialize an array
+    the guard below would discard anyway)."""
+    d = _cache_dir(results_dir, key)
+    os.makedirs(d, exist_ok=True)
+
+    def _atomic_save(name: str, arr) -> None:
+        tmp = os.path.join(d, f".{name}.tmp.npy")
+        np.save(tmp, np.asarray(arr))
+        os.replace(tmp, os.path.join(d, f"{name}.npy"))
+
+    if trajectory is not None and not os.path.exists(
+        os.path.join(d, "trajectory.npy")
+    ):
+        _atomic_save("trajectory", trajectory)
+    if null_embeddings is not None and not os.path.exists(
+        os.path.join(d, f"null_embeddings{null_tag}.npy")
+    ):
+        _atomic_save(f"null_embeddings{null_tag}", null_embeddings)
+    if meta is not None:
+        with open(os.path.join(d, "meta.json"), "w") as f:
+            json.dump(meta, f, indent=1, default=str)
+    return d
