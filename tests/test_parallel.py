@@ -263,6 +263,70 @@ def test_sharded_controlled_edit_matches_unsharded(mesh8):
     )
 
 
+def test_sharded_cached_source_edit_matches_unsharded(mesh8):
+    """The cached-source fast mode (pipelines/cached.py) under a (1,4,2)
+    frames×tensor mesh: GSPMD shards the capture trees (cross maps over the
+    frame axis, temporal maps over spatial positions) with no shard_map
+    changes; sharded must match unsharded, and the source replay must stay
+    bit-exact even sharded."""
+    from videop2p_tpu.control import make_controller
+    from videop2p_tpu.core import DDIMScheduler
+    from videop2p_tpu.models import UNet3DConditionModel, UNet3DConfig
+    from videop2p_tpu.pipelines import (
+        ddim_inversion_captured,
+        edit_sample,
+        make_unet_fn,
+    )
+    from videop2p_tpu.pipelines.cached import capture_windows
+    from videop2p_tpu.utils.tokenizers import WordTokenizer
+
+    mesh = make_mesh((1, 4, 2))
+    cfg = UNet3DConfig.tiny()
+    model = UNet3DConditionModel(config=cfg)
+    F, STEPS = 4, 3
+    x0 = jax.random.normal(jax.random.key(0), (1, F, 8, 8, 4))
+    cond = jax.random.normal(jax.random.key(1), (2, 77, cfg.cross_attention_dim))
+    uncond = jnp.zeros((77, cfg.cross_attention_dim))
+    params = jax.jit(model.init)(jax.random.key(2), x0, jnp.asarray(5), cond[:1])
+    fn = make_unet_fn(model)
+    sched = DDIMScheduler.create_sd()
+    ctx = make_controller(
+        ["a rabbit is jumping", "a origami rabbit is jumping"],
+        WordTokenizer(), num_steps=STEPS,
+        is_replace_controller=False,
+        cross_replace_steps=0.8, self_replace_steps=0.6,
+        blend_words=(["rabbit"], ["rabbit"]),
+        equalizer_params={"words": ["origami"], "values": [2.0]},
+    )
+    c, sw = capture_windows(ctx, STEPS)
+
+    def invcap(p, x):
+        return ddim_inversion_captured(
+            fn, p, sched, x, cond[:1], num_inference_steps=STEPS,
+            cross_len=c, self_window=sw, capture_blend=True, blend_res=(4, 4),
+        )
+
+    def edit(p, xt, cch):
+        return edit_sample(
+            fn, p, sched, xt, cond, uncond, num_inference_steps=STEPS,
+            ctx=ctx, source_uses_cfg=False, blend_res=(4, 4), cached_source=cch,
+        )
+
+    traj1, cc1 = jax.jit(invcap)(params, x0)
+    out1 = jax.jit(edit)(params, traj1[-1], cc1)
+
+    s_params = jax.device_put(
+        params, param_shardings(mesh, params, tensor_parallel=True)
+    )
+    s_x0 = jax.device_put(x0, latent_sharding(mesh))
+    traj2, cc2 = jax.jit(invcap)(s_params, s_x0)
+    out2 = jax.jit(edit)(s_params, traj2[-1], cc2)
+
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2), atol=2e-4)
+    # the replay exactness survives sharding
+    np.testing.assert_array_equal(np.asarray(out2[0]), np.asarray(s_x0[0]))
+
+
 def test_hybrid_mesh_single_slice_and_distributed_noop():
     """make_hybrid_mesh on one slice equals the plain reshape;
     initialize_distributed is a no-op without multi-host config."""

@@ -75,9 +75,14 @@ def project(
     ici_gbps: float = 100.0,
     shard_inv_s: Optional[float] = None,
     shard_edit_s: Optional[float] = None,
+    edit_streams: int = 3,
+    efficiency: float = 1.0,
 ) -> Dict:
     """Project the 4-chip fast-edit wall-clock from measured single-chip
     phase times. Returns the projection plus its full evidence.
+    ``edit_streams``: 3 for the live fast edit, 2 for the cached-source mode
+    (whose capture trees shard over frames with no extra collectives —
+    tests/test_parallel.py pins sharded==unsharded for it).
 
     ``shard_inv_s`` / ``shard_edit_s``: MEASURED single-chip wall-clock of
     the frames/sp-frame working point — exactly the per-chip compute of the
@@ -88,7 +93,7 @@ def project(
     N/sp×F² — a few ms/step either way at F≤8 since temporal sites are tiny.)
     """
     t_inv = traffic_table(1, frames, sp)   # inversion: 1 cond stream
-    t_edit = traffic_table(3, frames, sp)  # fast edit: 3 streams
+    t_edit = traffic_table(edit_streams, frames, sp)
     inv_mb = sum(r["total_mb_per_chip_per_step"] for r in t_inv)
     edit_mb = sum(r["total_mb_per_chip_per_step"] for r in t_edit)
     coll_inv = inv_mb * 1e6 / (ici_gbps * 1e9) * steps
@@ -96,8 +101,8 @@ def project(
     # "is not None": a legitimate 0.0 shard reading must not silently fall
     # back to linear scaling
     use_shard = shard_inv_s is not None and shard_edit_s is not None
-    proj_inv = (shard_inv_s if use_shard else inv_s / sp) + coll_inv
-    proj_edit = (shard_edit_s if use_shard else edit_s / sp) + coll_edit
+    proj_inv = (shard_inv_s if use_shard else inv_s / sp / efficiency) + coll_inv
+    proj_edit = (shard_edit_s if use_shard else edit_s / sp / efficiency) + coll_edit
     total = proj_inv + proj_edit
     return {
         "projected_v5e4_s": round(total, 2),
@@ -127,12 +132,100 @@ def project(
     }
 
 
+def project_official(
+    inv_s: float,
+    null_s: float,
+    off_edit_s: float,
+    *,
+    steps: int = 50,
+    frames: int = 8,
+    inner_steps: int = 3,
+    sp: int = 4,
+    ici_gbps: float = 100.0,
+    efficiency: float = 1.0,
+) -> Dict:
+    """Project the official-mode edit (inversion + null-text + full-CFG
+    controlled edit) onto the sp-chip frame-sharded mesh.
+
+    Null-text is per-frame UNet work (forwards + a remat backward on the
+    uncond branch) and shards over frames like everything else; its
+    per-outer-step collective volume is the 1-stream traffic times the
+    forward-equivalent count ``2 + 3·inner`` (backward ≈ 2 forwards of
+    traffic — conservative). ``efficiency`` (≤1) derates the per-chip
+    compute for small-batch loss, measured via the F/sp shard proxy.
+    """
+    t1 = traffic_table(1, frames, sp)
+    t4 = traffic_table(4, frames, sp)
+    mb1 = sum(r["total_mb_per_chip_per_step"] for r in t1)
+    mb4 = sum(r["total_mb_per_chip_per_step"] for r in t4)
+    coll_inv = mb1 * 1e6 / (ici_gbps * 1e9) * steps
+    coll_null = mb1 * 1e6 / (ici_gbps * 1e9) * steps * (2 + 3 * inner_steps)
+    coll_off = mb4 * 1e6 / (ici_gbps * 1e9) * steps
+    proj = (
+        (inv_s / sp / efficiency + coll_inv)
+        + (null_s / sp / efficiency + coll_null)
+        + (off_edit_s / sp / efficiency + coll_off)
+    )
+    single = inv_s + null_s + off_edit_s
+    return {
+        "projected_v5e4_s": round(proj, 2),
+        "single_chip_s": round(single, 2),
+        "parallel_efficiency": round(single / (sp * proj), 3),
+        "phases": {
+            "inversion_s": round(inv_s / sp / efficiency + coll_inv, 2),
+            "null_text_s": round(null_s / sp / efficiency + coll_null, 2),
+            "official_edit_s": round(off_edit_s / sp / efficiency + coll_off, 2),
+        },
+        "assumptions": {
+            "sp": sp, "ici_effective_gbps": ici_gbps,
+            "compute_efficiency": round(efficiency, 3),
+            "null_traffic_fwd_equivalents_per_outer": 2 + 3 * inner_steps,
+            "null_variant": f"fixed {inner_steps} inner steps (stable record)",
+        },
+    }
+
+
+def project_long(
+    e2e_s: float,
+    *,
+    steps: int = 50,
+    frames: int = 24,
+    sp: int = 4,
+    ici_gbps: float = 100.0,
+    efficiency: float = 1.0,
+) -> Dict:
+    """Project the 24-frame fast edit (BASELINE config 3) onto sp chips:
+    frames/sp = 6 frames per chip; inversion (1 stream) + live fast edit
+    (3 streams) collectives at the 24-frame site shapes."""
+    mb = sum(
+        r["total_mb_per_chip_per_step"]
+        for t in (traffic_table(1, frames, sp), traffic_table(3, frames, sp))
+        for r in t
+    )
+    coll = mb * 1e6 / (ici_gbps * 1e9) * steps
+    proj = e2e_s / sp / efficiency + coll
+    return {
+        "projected_v5e4_s": round(proj, 2),
+        "single_chip_s": round(e2e_s, 2),
+        "parallel_efficiency": round(e2e_s / (sp * proj), 3),
+        "collective_s": round(coll, 3),
+        "assumptions": {
+            "sp": sp, "ici_effective_gbps": ici_gbps,
+            "frames_per_chip": frames // sp,
+            "compute_efficiency": round(efficiency, 3),
+        },
+    }
+
+
 def main() -> None:
-    # measured single-chip phase times from the committed record
+    # measured single-chip phase times from the committed record; the
+    # headline inversion_s/edit_s are the CACHED-mode pair — the projection
+    # models the live sharded path, so prefer the live A/B readings
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     with open(os.path.join(root, "bench_details.json")) as f:
         bd = json.load(f)["breakdown"]
-    inv_s, edit_s = bd["inversion_s"], bd["edit_s"]
+    inv_s = bd.get("inversion_live_s", bd["inversion_s"])
+    edit_s = bd.get("edit_live_s", bd["edit_s"])
     shard_kw = {}
     if "shard2_inversion_s" in bd and "shard2_edit_s" in bd:
         shard_kw = dict(shard_inv_s=bd["shard2_inversion_s"],
@@ -203,8 +296,46 @@ def main() -> None:
     out_md = os.path.join(docs, "PROJECTION.md")
     with open(out_md, "w") as f:
         f.write("\n".join(lines) + "\n")
+
+    # measured small-batch efficiency from the shard proxy: the ratio of the
+    # ideal per-chip time (single-chip/sp) to the MEASURED F/sp-frame time;
+    # reused to derate the configs that have no dedicated proxy
+    eff = 1.0
+    if shard_kw:
+        ideal = (inv_s + edit_s) / 4
+        measured = shard_kw["shard_inv_s"] + shard_kw["shard_edit_s"]
+        if measured > 0:
+            eff = min(1.0, ideal / measured)
+
+    out = {"fast_edit_live": p}
+    # the CLI's default fast path: cached-source (2-stream edit). No shard
+    # proxy exists for it, so per-chip compute is linear-in-sp derated by
+    # the efficiency the LIVE proxy measured; collectives use the 2-stream
+    # traffic — the capture trees shard over frames, so base-map reads stay
+    # chip-local (tests/test_parallel.py pins sharded==unsharded)
+    if "inversion_s" in bd and "edit_s" in bd and "inversion_live_s" in bd:
+        # true measured single-chip times in; the derate applies only to the
+        # per-chip compute division inside project(), so single_chip_s and
+        # parallel_efficiency in the evidence stay honest
+        out["fast_edit_cached"] = project(
+            bd["inversion_s"], bd["edit_s"], edit_streams=2, efficiency=eff,
+        )
+        out["fast_edit_cached"]["assumptions"]["compute_scaling"] = (
+            f"linear in sp derated by the live shard proxy's measured "
+            f"efficiency {eff:.2f}"
+        )
+    if "null_text_fixed3_s" in bd and "official_edit_s" in bd:
+        out["official_edit"] = project_official(
+            inv_s, bd["null_text_fixed3_s"], bd["official_edit_s"],
+            efficiency=eff,
+        )
+    long_key = "long24_fast_edit_e2e_s_extrapolated"
+    if long_key in bd:
+        out["long24_fast_edit"] = project_long(bd[long_key], efficiency=eff)
+    if "shard2_samples" in bd:
+        out["shard_proxy_samples"] = bd["shard2_samples"]
     with open(os.path.join(docs, "projection_v5e4.json"), "w") as f:
-        json.dump(p, f, indent=2)
+        json.dump(out, f, indent=2)
     print(f"wrote {out_md}")
     print(json.dumps({k: p[k] for k in ("projected_v5e4_s", "parallel_efficiency")}))
 

@@ -89,7 +89,9 @@ def main(
     seed: int = 0,
     # cached-source fast mode (pipelines/cached.py): drop the source stream
     # from the edit batch and replay it exactly from the inversion trajectory;
-    # applies in --fast with eta=0 on an unsharded run, else falls back live
+    # applies in --fast with eta=0 (sharded meshes included — GSPMD shards
+    # the capture trees over frames; tests/test_parallel.py pins
+    # sharded==unsharded), else falls back live
     cached_source: bool = True,
     # persist/reuse inversion products under the results dir so a repeat edit
     # of the same clip skips DDIM inversion and null-text entirely (the
@@ -206,7 +208,7 @@ def main(
     # ---- DDIM inversion (+ null-text in full mode) ----------------------
     dep_w = dependent_weights if dependent_p2p else 0.0
 
-    use_cached = cached_source and fast and eta == 0 and mesh is None
+    use_cached = cached_source and fast and eta == 0
 
     # persisted-products lookup: on a hit the inversion walk (and, when
     # present, the null-text optimization) is skipped. NOT consulted when
@@ -265,9 +267,14 @@ def main(
         budget_gb = float(os.environ.get("VIDEOP2P_CACHED_MAPS_BUDGET_GB", "6"))
         _, cached_shapes = jax.eval_shape(captured_fn, params, latents, key)
         map_gb = tree_bytes((cached_shapes.cross_maps, cached_shapes.temporal_maps)) / 2**30
-        if map_gb > budget_gb:
+        # the budget is per chip: on a frame-sharded mesh the capture trees
+        # shard over frames/spatial positions, so each chip holds 1/sp of
+        # the global bytes — exactly what makes long-video cached mode fit
+        sp_shard = int(mesh.split(",")[1]) if mesh else 1
+        per_chip_gb = map_gb / max(sp_shard, 1)
+        if per_chip_gb > budget_gb:
             print(
-                f"[p2p] cached-source maps need {map_gb:.1f} GiB "
+                f"[p2p] cached-source maps need {per_chip_gb:.1f} GiB/chip "
                 f"(> budget {budget_gb:.1f} GiB) — falling back to the live "
                 "source stream"
             )
@@ -275,7 +282,8 @@ def main(
         else:
             print(
                 f"[p2p] cached-source fast mode: cross window {cross_len} steps, "
-                f"self window {self_window}, maps {map_gb:.2f} GiB"
+                f"self window {self_window}, maps {map_gb:.2f} GiB global / "
+                f"{per_chip_gb:.2f} GiB per chip"
             )
 
     # consult the persisted products only once the cached-source decision is

@@ -163,7 +163,7 @@ def edit_sample(
             uncond_embeddings, cached_source,
             num_inference_steps=num_inference_steps,
             guidance_scale=guidance_scale, ctx=ctx,
-            blend_res=blend_res, key=key,
+            blend_res=blend_res,
         )
 
     # the source stream's per-step uncond: the null-text sequence when given,
@@ -315,12 +315,12 @@ def _edit_sample_cached(
     guidance_scale: float,
     ctx: Optional[ControlContext],
     blend_res: Optional[Tuple[int, int]],
-    key: Optional[jax.Array],
 ) -> jax.Array:
     """The cached-source denoise loop: only the P−1 edit streams run the
     UNet; the source stream is read off the reversed inversion trajectory
     (exact replay) and its controller inputs come from the capture
-    (:mod:`videop2p_tpu.pipelines.cached`).
+    (:mod:`videop2p_tpu.pipelines.cached`). Fully deterministic — the
+    ``eta=0`` requirement means no randomness enters the loop.
 
     Inputs arrive normalized by :func:`edit_sample` (latents broadcast to
     (P, F, h, w, C), uncond as (L, D) — or per-frame in multi mode).
@@ -334,8 +334,6 @@ def _edit_sample_cached(
     latent_hw = latents.shape[2:4]
     text_len = cond_embeddings.shape[-2]
     timesteps = jnp.asarray(scheduler.timesteps(num_inference_steps))
-    if key is None:
-        key = jax.random.key(0)
 
     edit_latents = latents[1:]  # (E, F, h, w, C), fp32 from the caller
     cond_edit = cond_embeddings[1:]
