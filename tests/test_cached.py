@@ -266,6 +266,136 @@ def test_cached_with_empty_windows(sched, tiny):
                     cached_source=broken)
 
 
+def test_cached_multi_frame_embeddings(sched, tiny, ctx5):
+    """Per-frame ("multi") conditioning through the cached path, twice over:
+
+    1. identical rows per frame must match the shared-embedding cached edit
+       (batching consistency);
+    2. per-frame-DISTINCT rows must match the LIVE fast edit with the same
+       embeddings and no controller (the edit streams are then independent
+       of the source stream) — this pins the per-frame ROUTING: a bug that
+       collapsed conditioning to one frame would produce different outputs
+       here but not in (1)."""
+    fn, params, cfg = tiny
+    x0 = jax.random.normal(jax.random.key(15), SHAPE)
+    cond = jax.random.normal(jax.random.key(16), (2, 77, cfg.cross_attention_dim))
+    uncond = jnp.zeros((77, cfg.cross_attention_dim))
+    c, sw = _windows(ctx5, STEPS)
+    traj, cached, out_shared = _run_cached(
+        fn, params, sched, x0, cond, uncond, ctx5, c, sw
+    )
+    cond_multi = jnp.repeat(cond[:, None], SHAPE[1], axis=1)  # (P, F, L, D)
+    out_multi = jax.jit(
+        lambda p, xt, cch: edit_sample(
+            fn, p, sched, xt, cond_multi, uncond,
+            num_inference_steps=STEPS, ctx=ctx5, source_uses_cfg=False,
+            blend_res=(4, 4), cached_source=cch,
+        )
+    )(params, traj[-1], cached)
+    np.testing.assert_allclose(
+        np.asarray(out_shared), np.asarray(out_multi), atol=1e-5
+    )
+
+    # (2) distinct per-frame rows, no controller: cached == live per stream
+    cond_distinct = cond_multi + 0.1 * jax.random.normal(
+        jax.random.key(21), cond_multi.shape
+    )
+    _, cached0 = ddim_inversion_captured(
+        fn, params, sched, x0, cond[:1], num_inference_steps=STEPS,
+        cross_len=0, self_window=(0, 0),
+    )
+    out_c = jax.jit(
+        lambda p, xt, cch: edit_sample(
+            fn, p, sched, xt, cond_distinct, uncond,
+            num_inference_steps=STEPS, source_uses_cfg=False, cached_source=cch,
+        )
+    )(params, traj[-1], cached0)
+    out_l = jax.jit(
+        lambda p, xt: edit_sample(
+            fn, p, sched, xt, cond_distinct, uncond,
+            num_inference_steps=STEPS, source_uses_cfg=False,
+        )
+    )(params, traj[-1])
+    np.testing.assert_allclose(np.asarray(out_c[1]), np.asarray(out_l[1]), atol=1e-5)
+
+
+def test_cached_spatial_replace(sched, tiny):
+    """SpatialReplace through the cached path: while active, every edit
+    stream's latent is overwritten with the source's (run_videop2p.py:235-246)
+    — with the source read from the trajectory, an always-active injection
+    makes the edit stream equal the exact reconstruction."""
+    from videop2p_tpu.control import make_spatial_replace_controller
+
+    fn, params, cfg = tiny
+    x0 = jax.random.normal(jax.random.key(17), SHAPE)
+    cond = jax.random.normal(jax.random.key(18), (2, 77, cfg.cross_attention_dim))
+    uncond = jnp.zeros((77, cfg.cross_attention_dim))
+    ctx_sr = make_spatial_replace_controller(0.0, STEPS)  # inject every step
+    traj, cached, out = _run_cached(
+        fn, params, sched, x0, cond, uncond, ctx_sr, 0, (0, 0)
+    )
+    np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(x0[0]))
+    # last step's injection puts the edit stream on the source's post-step
+    # latent — i.e. the exact reconstruction
+    np.testing.assert_allclose(np.asarray(out[1]), np.asarray(x0[0]), atol=1e-6)
+
+    # the window BOUNDARY, pinned exactly: with injection active on all but
+    # the final step, out[1] must equal one CFG denoise step applied to the
+    # source's post-injection latent trajectory[1] at the last timestep —
+    # an off-by-one in the gate (`<=` instead of `<`) would give x0 instead
+    from videop2p_tpu.control.controllers import ControlContext
+    from videop2p_tpu.utils.tokenizers import MAX_NUM_WORDS
+
+    ctx_partial = ControlContext(
+        cross_replace_alpha=jnp.zeros((STEPS + 1, 1, 1, 1, MAX_NUM_WORDS)),
+        kind="empty", num_prompts=2, self_replace_range=(0, 0),
+        spatial_replace_until=STEPS - 1,
+    )
+    _, _, out_p = _run_cached(
+        fn, params, sched, x0, cond, uncond, ctx_partial, 0, (0, 0)
+    )
+    ts = sched.timesteps(STEPS)
+    t_last = jnp.asarray(ts[-1])
+    lat = traj[1]  # source latent after edit step STEPS−2 (post-injection)
+    eps_u, _ = fn(params, lat, t_last, uncond[None], None)
+    eps_c, _ = fn(params, lat, t_last, cond[1:], None)
+    eps = eps_u + 7.5 * (eps_c - eps_u)
+    expected, _ = sched.step(eps, t_last, lat, STEPS, eta=0.0, variance_noise=None)
+    np.testing.assert_allclose(np.asarray(out_p[1]), np.asarray(expected[0]), atol=1e-5)
+    # a `<=` gate would have injected on the final step too, making out[1]
+    # BITWISE equal to x0 (the one-step denoise only approximates it)
+    assert np.abs(np.asarray(out_p[1]) - np.asarray(x0[0])).max() > 0.0
+
+
+def test_cached_three_prompts(sched, tiny):
+    """P=3 (two edit streams) through the cached path: batch factors as
+    2 uncond + 2 cond edits, both edits read the same cached base maps."""
+    fn, params, cfg = tiny
+    prompts = [
+        "a rabbit is jumping",
+        "a origami rabbit is jumping",
+        "a plush rabbit is jumping",
+    ]
+    ctx3 = make_controller(
+        prompts, WordTokenizer(), num_steps=STEPS,
+        is_replace_controller=False,
+        cross_replace_steps=0.4, self_replace_steps=0.6,
+        # one blend-word entry PER PROMPT (a 2-entry tuple would silently
+        # zip-truncate and zero the third prompt's blend alpha row)
+        blend_words=(["rabbit"], ["rabbit"], ["rabbit"]),
+    )
+    x0 = jax.random.normal(jax.random.key(19), SHAPE)
+    cond = jax.random.normal(jax.random.key(20), (3, 77, cfg.cross_attention_dim))
+    uncond = jnp.zeros((77, cfg.cross_attention_dim))
+    c, sw = _windows(ctx3, STEPS)
+    traj, cached, out = _run_cached(fn, params, sched, x0, cond, uncond, ctx3, c, sw)
+    assert out.shape == (3,) + SHAPE[1:]
+    assert np.isfinite(np.asarray(out)).all()
+    np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(x0[0]))
+    # the two edit streams see different prompts and must differ
+    assert not np.allclose(np.asarray(out[1]), np.asarray(out[2]))
+
+
 def test_cached_rejects_incompatible_modes(sched, tiny):
     fn, params, cfg = tiny
     x0 = jax.random.normal(jax.random.key(11), SHAPE)
