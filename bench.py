@@ -492,7 +492,12 @@ def main() -> None:
         # round its primary record: partial breakdown still gets written.
         try:
             from videop2p_tpu.core import DDPMScheduler
-            from videop2p_tpu.train import TrainState, TuneConfig, make_optimizer, train_step
+            from videop2p_tpu.train import (
+                TrainState,
+                TuneConfig,
+                make_optimizer,
+                train_steps,
+            )
 
             # ---- live-source A/B: the reference-faithful fast mode (live
             # 3-stream edit) against the cached headline above — the bench
@@ -724,22 +729,31 @@ def main() -> None:
             ddpm = DDPMScheduler.create_sd()
             k3, k4, k5 = jax.random.split(jax.random.fold_in(base, 99), 3)
             lat_train = jax.random.normal(k3, (1, F, 64, 64, 4))
-            step = jax.jit(
-                lambda s, k: train_step(fn_r, tx, s, ddpm, lat_train, cond[:1], k)
+            # the production path (cli/run_tuning.py, steps_per_call=25):
+            # TRAIN_STEPS steps as ONE scanned device program. Per-step host
+            # dispatch through the tunnel cost ~2× the device step time as a
+            # Python loop (r4 device trace: 384 ms/step vs 456-794 ms wall),
+            # and the single-call fixed overhead (~1.3 s) needs ≥25 steps to
+            # amortize (measured: K=5 → 640 ms/step, K=25 → 388 ms/step)
+            TRAIN_STEPS = 25
+            steps_fn = jax.jit(
+                lambda s, k: train_steps(
+                    fn_r, tx, s, ddpm, lat_train, cond[:1], k,
+                    num_steps=TRAIN_STEPS,
+                )
             )
-            state, _ = step(state, k4)  # compile + step 1
+            state, _ = steps_fn(state, k4)  # compile + first chunk
             hard_block(state.trainable)
-            TRAIN_STEPS = 5
             holder = {"state": state, "off": 0}
 
             def tune_loop(_):
-                s = holder["state"]
-                for i in range(TRAIN_STEPS):
-                    # the evolving state + per-attempt key offset keep every
-                    # step's args value-fresh across retries
-                    s, loss = step(s, jax.random.fold_in(k5, holder["off"] + i))
-                holder["state"], holder["off"] = s, holder["off"] + TRAIN_STEPS
-                return loss
+                # the evolving state + per-attempt key offset keep every
+                # chunk's args value-fresh across retries
+                s, chunk_losses = steps_fn(
+                    holder["state"], jax.random.fold_in(k5, holder["off"])
+                )
+                holder["state"], holder["off"] = s, holder["off"] + 1
+                return chunk_losses[-1]
 
             # per-step floor: forward + backward ≥ 3 forward-equivalents (remat
             # recompute adds more; 3× is the conservative bound)

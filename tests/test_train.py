@@ -20,6 +20,7 @@ from videop2p_tpu.train import (
     save_checkpoint,
     trainable_mask,
     train_step,
+    train_steps,
 )
 
 
@@ -92,6 +93,49 @@ def test_train_step_descends_and_freezes(tiny):
             assert same, f"frozen param {k} changed"
             unchanged += 1
     assert changed > 0 and unchanged > 0
+
+
+def test_train_steps_scan_matches_sequential(tiny):
+    """train_steps (one lax.scan over K steps — the CLI's dispatch-batched
+    loop) must reproduce K sequential train_step calls with per-step keys
+    derived by absolute step index (fold_in(base, step)) — and chunking must
+    therefore be boundary-invariant: 4 = 1+3 steps bit-for-bit."""
+    fn, variables, latents, text = tiny
+    params = variables["params"]
+    tx = make_optimizer(TuneConfig(learning_rate=1e-3))
+    sched = DDPMScheduler.create_sd()
+    K = 4
+    base = jax.random.key(7)
+
+    state_seq = TrainState.create(params, tx)
+    seq_losses = []
+    for i in range(K):
+        state_seq, loss = jax.jit(
+            lambda s, kk: train_step(fn, tx, s, sched, latents, text, kk)
+        )(state_seq, jax.random.fold_in(base, i))
+        seq_losses.append(float(loss))
+
+    state_scan = TrainState.create(params, tx)
+    state_scan, losses = jax.jit(
+        lambda s, kk: train_steps(fn, tx, s, sched, latents, text, kk, num_steps=K)
+    )(state_scan, base)
+    np.testing.assert_allclose(np.asarray(losses), np.asarray(seq_losses), rtol=1e-5)
+    assert int(state_scan.step) == K
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-6
+        ),
+        state_scan.trainable, state_seq.trainable,
+    )
+
+    # chunk-boundary invariance: 1 then 3 steps == 4 steps
+    s2 = TrainState.create(params, tx)
+    s2, l1 = train_steps(fn, tx, s2, sched, latents, text, base, num_steps=1)
+    s2, l3 = train_steps(fn, tx, s2, sched, latents, text, base, num_steps=3)
+    np.testing.assert_allclose(
+        np.concatenate([np.asarray(l1), np.asarray(l3)]), np.asarray(losses),
+        rtol=1e-5,
+    )
 
 
 def test_dependent_noise_train_path(tiny):

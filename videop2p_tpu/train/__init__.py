@@ -18,6 +18,7 @@ from videop2p_tpu.train.tuner import (
     make_lr_schedule,
     make_optimizer,
     train_step,
+    train_steps,
 )
 
 __all__ = [
@@ -34,4 +35,5 @@ __all__ = [
     "make_lr_schedule",
     "make_optimizer",
     "train_step",
+    "train_steps",
 ]

@@ -40,7 +40,14 @@ from videop2p_tpu.train.masking import (
     partition_params,
 )
 
-__all__ = ["TuneConfig", "TrainState", "make_optimizer", "make_lr_schedule", "train_step"]
+__all__ = [
+    "TuneConfig",
+    "TrainState",
+    "make_optimizer",
+    "make_lr_schedule",
+    "train_step",
+    "train_steps",
+]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -195,4 +202,60 @@ def train_step(
             opt_state=opt_state,
         ),
         loss,
+    )
+
+
+def train_steps(
+    unet_fn: UNetFn,
+    tx: optax.GradientTransformation,
+    state: TrainState,
+    scheduler: DDPMScheduler,
+    latents: jax.Array,
+    text_embeddings: jax.Array,
+    key: jax.Array,
+    *,
+    num_steps: int,
+    dependent_sampler: Optional[DependentNoiseSampler] = None,
+) -> Tuple[TrainState, jax.Array]:
+    """``num_steps`` tuning steps as ONE ``lax.scan`` — one device program
+    instead of per-step host dispatches. On this harness each dispatch rides
+    the TPU tunnel (~10²-ms round trip); device-trace accounting put the
+    step itself at ~384 ms while the per-dispatch loop measured 456–794 ms —
+    the scan recovers that gap for the real Stage-1 loop, not just a bench.
+
+    Stage-1 trains on a SINGLE clip (dataset length 1, run_tuning.py:179),
+    so the batch is the same ``latents`` every step and scanning over steps
+    changes nothing but the per-step PRNG key. Only (step, trainable,
+    opt_state) ride the scan carry — the frozen 90 % of the UNet enters as
+    a closure constant, since a carried tree is held twice in the executable
+    (carry-in + carry-out) and would double its HBM.
+
+    ``key`` is the RUN's base key, constant across chunks: each step's key
+    is ``fold_in(key, absolute_step)``, so the noise sequence depends only
+    on (seed, step index) — chunk boundaries (logging/checkpoint cadence,
+    ``steps_per_call``) and resume points cannot change the trained model.
+
+    Returns (state, per-step losses (num_steps,)).
+    """
+    frozen = state.frozen
+
+    def body(carry, _):
+        step, trainable, opt_state = carry
+        s = TrainState(step=step, trainable=trainable, frozen=frozen,
+                       opt_state=opt_state)
+        s, loss = train_step(
+            unet_fn, tx, s, scheduler, latents, text_embeddings,
+            jax.random.fold_in(key, step),
+            dependent_sampler=dependent_sampler,
+        )
+        return (s.step, s.trainable, s.opt_state), loss
+
+    (step, trainable, opt_state), losses = jax.lax.scan(
+        body, (state.step, state.trainable, state.opt_state), None,
+        length=num_steps,
+    )
+    return (
+        TrainState(step=step, trainable=trainable, frozen=frozen,
+                   opt_state=opt_state),
+        losses,
     )
