@@ -369,7 +369,7 @@ def build_fast_edit_working_point(*, num_frames: int = 8, num_steps: int = 50,
     )
     x_warm = jax.random.normal(k7, x0.shape, x0.dtype)
 
-    invert_captured = edit_cached = None
+    invert_captured = edit_cached = e2e_cached = None
     if cached:
         from videop2p_tpu.pipelines.cached import capture_windows
 
@@ -388,10 +388,25 @@ def build_fast_edit_working_point(*, num_frames: int = 8, num_steps: int = 50,
             )
         )
 
+        # the CLI's actual cached fast path: the SHARED fused program
+        # (pipelines.cached_fast_edit — cli/run_videop2p.py jits the same
+        # function), so the benchmarked program cannot drift from the one
+        # users run; one host dispatch, capture trees never leave the device
+        from videop2p_tpu.pipelines import cached_fast_edit
+
+        e2e_cached = jax.jit(
+            lambda p, x: cached_fast_edit(
+                fn, p, sched, x, cond[:1], cond, uncond, ctx,
+                num_inference_steps=num_steps,
+                cross_len=cross_len, self_window=self_window,
+            )[1]
+        )
+
     return SimpleNamespace(
         invert=invert, edit=edit, fn=fn, params=params, sched=sched, ctx=ctx,
         cond=cond, uncond=uncond, x0=x0, x_warm=x_warm, base=base,
         invert_captured=invert_captured, edit_cached=edit_cached,
+        e2e_cached=e2e_cached,
     )
 
 
@@ -414,11 +429,16 @@ def main() -> None:
     # headline = the cached-source fast mode (the CLI default,
     # pipelines/cached.py): the inversion walk captures the controlled-site
     # maps + blend contributions, and the edit then runs only TWO UNet
-    # streams — the source stream replays the trajectory exactly.
+    # streams — the source stream replays the trajectory exactly. The
+    # headline number is the FUSED single-dispatch program (capture + edit
+    # in one jit, as the CLI runs it): the separate phases below measured
+    # 12.25–13.0 s summed while the fused call reads 11.8 s — each dispatch
+    # rides the tunnel, and fusing drops one.
     # warm-up (compile) on a DIFFERENT input: memoized identical calls would
     # fake a near-zero wall-clock for the measured run
     warm_traj, warm_cached = wp.invert_captured(params, x_warm)
     out = hard_block(wp.edit_cached(params, warm_traj[-1], warm_cached))
+    hard_block(wp.e2e_cached(params, x_warm + 0.001))
 
     peak = _peak_flops()
     # inversion is 1 cond stream (map capture adds HBM writes, no FLOPs); the
@@ -444,9 +464,17 @@ def main() -> None:
         "edit",
     )
     out, edit_s = r_edit.out, r_edit.seconds
-    elapsed = inv_s + edit_s
+    r_e2e = measure_with_floor(
+        lambda x: wp.e2e_cached(params, x),
+        [jax.random.normal(jax.random.fold_in(base, 11), x0.shape, x0.dtype),
+         jax.random.normal(jax.random.fold_in(base, 12), x0.shape, x0.dtype)],
+        (inv_flops + edit_flops) / peak,
+        "fused e2e",
+    )
+    elapsed = r_e2e.seconds
 
     assert bool(jnp.isfinite(out.astype(jnp.float32)).all()), "non-finite output"
+    assert bool(jnp.isfinite(r_e2e.out.astype(jnp.float32)).all()), "non-finite e2e"
     # the cached replay guarantee, checked on-chip: the edit's source stream
     # IS the inversion input (max |out[0] − x_0| must be exactly 0)
     src_err = float(
@@ -464,9 +492,11 @@ def main() -> None:
     )
     rec.record("inversion_s", round(inv_s, 3), reading=r_inv)
     rec.record("edit_s", round(edit_s, 3), reading=r_edit)
+    # the headline: one fused dispatch (phase sum adds one tunnel round trip)
+    rec.record("fast_edit_e2e_fused_s", round(elapsed, 3), reading=r_e2e)
     rec.record("inversion_step_ms", round(inv_s / STEPS * 1e3, 1), derived=(r_inv,))
     rec.record("edit_step_ms", round(edit_s / STEPS * 1e3, 1), derived=(r_edit,))
-    rec.record("frames_per_sec", round(F / elapsed, 3), derived=(r_inv, r_edit))
+    rec.record("frames_per_sec", round(F / elapsed, 3), derived=(r_e2e,))
     if peak == peak:  # known peak-FLOPs device only (NaN is not valid JSON)
         rec.record("mfu_inversion", round(inv_flops / inv_s / peak, 3), derived=(r_inv,))
         rec.record("mfu_edit", round(edit_flops / edit_s / peak, 3), derived=(r_edit,))

@@ -224,6 +224,13 @@ def main(
     if g > 1 and steps_per_call % g and g % steps_per_call:
         aligned = math.gcd(steps_per_call, g)
         if aligned >= 5:
+            print(
+                f"[tune] steps_per_call {steps_per_call} → {aligned} to align "
+                f"with the log/checkpoint/validation cadences (gcd {g}); "
+                "smaller chunks amortize the per-call dispatch overhead less "
+                "— align the cadences to a multiple of steps_per_call to "
+                "keep the full chunk"
+            )
             steps_per_call = aligned
     t0 = time.time()
     # per-step noise keys derive from (this run key, absolute step) inside

@@ -244,7 +244,7 @@ def main(
     # multi-host mesh would race on the same entry
     reuse_inversion = reuse_inversion and mesh is None and jax.process_count() == 1
 
-    cached = None
+
     if use_cached:
         from videop2p_tpu.pipelines.cached import capture_windows
 
@@ -300,7 +300,38 @@ def main(
 
     key, ik = jax.random.split(key)
     null_embeddings = None
-    if reused is not None:
+    out = None
+    if use_cached:
+        # capture + controlled denoise as ONE device program (the shared
+        # pipelines.cached_fast_edit — the same program bench.py measures):
+        # a second dispatch costs a tunnel round trip (~0.5-1 s measured),
+        # and the capture trees never surface as program outputs
+        from videop2p_tpu.pipelines import cached_fast_edit
+
+        print("Start Video-P2P!")
+        t0 = time.time()
+        with phase_timer("cached_invert_edit"):
+            traj, out = jax.jit(
+                lambda p, x, k: cached_fast_edit(
+                    unet_fn, p, sched, x, cond_src, cond_all, uncond, ctx,
+                    num_inference_steps=NUM_DDIM_STEPS,
+                    guidance_scale=GUIDANCE_SCALE,
+                    cross_len=cross_len, self_window=self_window,
+                    dependent_weight=dep_w,
+                    dependent_sampler=sampler if dep_w > 0 else None,
+                    key=k,
+                )
+            )(params, latents, ik)
+            out = jax.block_until_ready(out)
+        print(f"[p2p] cached invert+edit done in {time.time() - t0:.1f}s")
+        if reuse_inversion:
+            save_inversion(
+                output_folder, inv_key, np.asarray(traj),
+                meta={"image_path": image_path, "prompt": prompt,
+                      "steps": NUM_DDIM_STEPS, "width": width,
+                      "video_len": video_len, "fast": fast},
+            )
+    elif reused is not None:
         traj_np, null_np = reused
         print(f"[p2p] reusing persisted inversion products (key {inv_key}) — "
               "skipping DDIM inversion"
@@ -311,18 +342,15 @@ def main(
             null_embeddings = jnp.asarray(null_np)
     else:
         with phase_timer("ddim_inversion"):
-            if use_cached:
-                traj, cached = jax.jit(captured_fn)(params, latents, ik)
-            else:
-                traj = jax.jit(
-                    lambda p, x, k: ddim_inversion(
-                        unet_fn, p, sched, x, cond_src,
-                        num_inference_steps=NUM_DDIM_STEPS,
-                        dependent_weight=dep_w,
-                        dependent_sampler=sampler if dep_w > 0 else None,
-                        key=k,
-                    )
-                )(params, latents, ik)
+            traj = jax.jit(
+                lambda p, x, k: ddim_inversion(
+                    unet_fn, p, sched, x, cond_src,
+                    num_inference_steps=NUM_DDIM_STEPS,
+                    dependent_weight=dep_w,
+                    dependent_sampler=sampler if dep_w > 0 else None,
+                    key=k,
+                )
+            )(params, latents, ik)
             x_t = jax.block_until_ready(traj[-1])
         if reuse_inversion:
             save_inversion(
@@ -360,24 +388,13 @@ def main(
             )
         jax.clear_caches()
 
-    # ---- controlled denoise ---------------------------------------------
-    print("Start Video-P2P!")
-    key, ek = jax.random.split(key)
-    t0 = time.time()
-    with phase_timer("edit_sample"):
-        if use_cached:
-            out = jax.jit(
-                lambda p, x, u, c, k: edit_sample(
-                    unet_fn, p, sched, x, cond_all, u,
-                    num_inference_steps=NUM_DDIM_STEPS,
-                    guidance_scale=GUIDANCE_SCALE,
-                    ctx=ctx,
-                    source_uses_cfg=False,
-                    key=k,
-                    cached_source=c,
-                )
-            )(params, x_t, uncond, cached, ek)
-        else:
+    # ---- controlled denoise (skipped when the fused cached path already
+    # produced the output above) ------------------------------------------
+    if out is None:
+        print("Start Video-P2P!")
+        key, ek = jax.random.split(key)
+        t0 = time.time()
+        with phase_timer("edit_sample"):
             out = jax.jit(
                 lambda p, x, u, k: edit_sample(
                     unet_fn, p, sched, x, cond_all, u,
@@ -391,8 +408,8 @@ def main(
                     null_uncond_embeddings=null_embeddings,
                 )
             )(params, x_t, uncond, ek)
-        out = jax.block_until_ready(out)
-    print(f"[p2p] controlled denoise done in {time.time() - t0:.1f}s")
+            out = jax.block_until_ready(out)
+        print(f"[p2p] controlled denoise done in {time.time() - t0:.1f}s")
 
     with phase_timer("vae_decode"):
         videos = decode_video(bundle.vae, bundle.vae_params, out.astype(dtype))

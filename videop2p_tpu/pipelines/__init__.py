@@ -6,11 +6,13 @@ from videop2p_tpu.pipelines.inversion import (
     ddim_inversion_captured,
     null_text_optimization,
 )
+from videop2p_tpu.pipelines.fast import cached_fast_edit
 from videop2p_tpu.pipelines.sampling import edit_sample, make_unet_fn
 from videop2p_tpu.pipelines.stores import blend_maps_from_store, flatten_store
 
 __all__ = [
     "CachedSource",
+    "cached_fast_edit",
     "ddim_inversion",
     "ddim_inversion_captured",
     "null_text_optimization",

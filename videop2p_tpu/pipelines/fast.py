@@ -1,0 +1,66 @@
+"""The fused cached-source fast edit: capture-inversion + controlled edit
+as one traceable function.
+
+One device program = one host dispatch (each dispatch rides the TPU tunnel
+at ~0.5–1 s on this harness), and the multi-GiB capture trees never surface
+as program outputs. Shared by the CLI (cli/run_videop2p.py) and the bench
+(bench.py) so the benchmarked program IS the program users run — the two
+cannot drift apart.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+
+from videop2p_tpu.control.controllers import ControlContext
+from videop2p_tpu.core.ddim import DDIMScheduler
+from videop2p_tpu.core.noise import DependentNoiseSampler
+from videop2p_tpu.pipelines.inversion import ddim_inversion_captured
+from videop2p_tpu.pipelines.sampling import UNetFn, edit_sample
+
+__all__ = ["cached_fast_edit"]
+
+
+def cached_fast_edit(
+    unet_fn: UNetFn,
+    params,
+    scheduler: DDIMScheduler,
+    latents: jax.Array,
+    cond_src: jax.Array,
+    cond_all: jax.Array,
+    uncond: jax.Array,
+    ctx: Optional[ControlContext],
+    *,
+    num_inference_steps: int = 50,
+    guidance_scale: float = 7.5,
+    cross_len: int = 0,
+    self_window: Tuple[int, int] = (0, 0),
+    dependent_weight: float = 0.0,
+    dependent_sampler: Optional[DependentNoiseSampler] = None,
+    key: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Capture-inversion of ``latents`` under ``cond_src`` followed by the
+    cached-source controlled edit under ``cond_all``/``uncond``. Returns
+    ``(trajectory, edited_latents)`` — the trajectory for persistence, the
+    (P, F, h, w, C) output with stream 0 the exact reconstruction."""
+    trajectory, cached = ddim_inversion_captured(
+        unet_fn, params, scheduler, latents, cond_src,
+        num_inference_steps=num_inference_steps,
+        cross_len=cross_len,
+        self_window=self_window,
+        capture_blend=ctx is not None and ctx.blend is not None,
+        dependent_weight=dependent_weight,
+        dependent_sampler=dependent_sampler,
+        key=key,
+    )
+    edited = edit_sample(
+        unet_fn, params, scheduler, trajectory[-1], cond_all, uncond,
+        num_inference_steps=num_inference_steps,
+        guidance_scale=guidance_scale,
+        ctx=ctx,
+        source_uses_cfg=False,
+        cached_source=cached,
+    )
+    return trajectory, edited
