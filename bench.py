@@ -466,8 +466,8 @@ def main() -> None:
     out, edit_s = r_edit.out, r_edit.seconds
     r_e2e = measure_with_floor(
         lambda x: wp.e2e_cached(params, x),
-        [jax.random.normal(jax.random.fold_in(base, 11), x0.shape, x0.dtype),
-         jax.random.normal(jax.random.fold_in(base, 12), x0.shape, x0.dtype)],
+        [jax.random.normal(jax.random.fold_in(base, k), x0.shape, x0.dtype)
+         for k in (11, 12, 13)],
         (inv_flops + edit_flops) / peak,
         "fused e2e",
     )
@@ -475,6 +475,12 @@ def main() -> None:
 
     assert bool(jnp.isfinite(out.astype(jnp.float32)).all()), "non-finite output"
     assert bool(jnp.isfinite(r_e2e.out.astype(jnp.float32)).all()), "non-finite e2e"
+    # exactness of the HEADLINE program itself: the fused edit's stream 0 is
+    # the inversion input bit-for-bit (the input IS x_0 here)
+    e2e_src_err = float(jnp.max(jnp.abs(
+        r_e2e.out[0].astype(jnp.float32) - r_e2e.x_used[0].astype(jnp.float32)
+    )))
+    assert e2e_src_err == 0.0, f"fused cached replay not exact: {e2e_src_err}"
     # the cached replay guarantee, checked on-chip: the edit's source stream
     # IS the inversion input (max |out[0] − x_0| must be exactly 0)
     src_err = float(

@@ -37,7 +37,6 @@ from videop2p_tpu.data import load_frame_sequence
 from videop2p_tpu.models import decode_video, encode_video
 from videop2p_tpu.pipelines import (
     ddim_inversion,
-    ddim_inversion_captured,
     edit_sample,
     make_unet_fn,
     null_text_optimization,
@@ -252,20 +251,18 @@ def main(
         # exactly, so nothing else needs capturing
         cross_len, self_window = capture_windows(ctx, NUM_DDIM_STEPS)
 
-        def captured_fn(p, x, k):
-            return ddim_inversion_captured(
-                unet_fn, p, sched, x, cond_src,
-                num_inference_steps=NUM_DDIM_STEPS,
-                cross_len=cross_len,
-                self_window=self_window,
-                capture_blend=ctx.blend is not None,
-                dependent_weight=dep_w,
-                dependent_sampler=sampler if dep_w > 0 else None,
-                key=k,
-            )
+        from videop2p_tpu.pipelines.fast import capture_shapes
 
         budget_gb = float(os.environ.get("VIDEOP2P_CACHED_MAPS_BUDGET_GB", "6"))
-        _, cached_shapes = jax.eval_shape(captured_fn, params, latents, key)
+        # the shape check shares cached_fast_edit's OWN capture call, so the
+        # budget always sizes exactly what the fused program will materialize
+        _, cached_shapes = capture_shapes(
+            unet_fn, params, sched, latents, cond_src, ctx,
+            num_inference_steps=NUM_DDIM_STEPS,
+            cross_len=cross_len, self_window=self_window,
+            dependent_weight=dep_w,
+            dependent_sampler=sampler if dep_w > 0 else None,
+        )
         map_gb = tree_bytes((cached_shapes.cross_maps, cached_shapes.temporal_maps)) / 2**30
         # the budget is per chip: on a frame-sharded mesh the capture trees
         # shard over frames/spatial positions, so each chip holds 1/sp of
@@ -361,6 +358,12 @@ def main(
             )
 
     if not fast and null_embeddings is None:
+        # the official mode exists for reference parity: null-text spends
+        # minutes optimizing embeddings so the source stream approximately
+        # reconstructs under CFG — the cached --fast mode reconstructs
+        # EXACTLY at ~1/20th the cost (pipelines/cached.py)
+        print("[p2p] note: --fast (cached-source) reconstructs the source "
+              "exactly without null-text optimization")
         # loaded executables count against HBM: drop the inversion program
         # before compiling the null-text grad program, and that one before
         # the CFG edit (a 16 GB chip OOMs with all three resident)

@@ -20,7 +20,41 @@ from videop2p_tpu.core.noise import DependentNoiseSampler
 from videop2p_tpu.pipelines.inversion import ddim_inversion_captured
 from videop2p_tpu.pipelines.sampling import UNetFn, edit_sample
 
-__all__ = ["cached_fast_edit"]
+__all__ = ["cached_fast_edit", "capture_shapes"]
+
+
+def capture_shapes(
+    unet_fn: UNetFn,
+    params,
+    scheduler: DDIMScheduler,
+    latents,
+    cond_src,
+    ctx: Optional[ControlContext],
+    *,
+    num_inference_steps: int = 50,
+    cross_len: int = 0,
+    self_window: Tuple[int, int] = (0, 0),
+    dependent_weight: float = 0.0,
+    dependent_sampler: Optional[DependentNoiseSampler] = None,
+):
+    """``eval_shape`` of the EXACT capture :func:`cached_fast_edit` will run
+    — for HBM budgeting (cli/run_videop2p.py). Sharing the call site means a
+    change to the fused program's capture cannot desynchronize the budget
+    check that gates it. Returns the (trajectory, CachedSource) shape tree.
+    """
+    return jax.eval_shape(
+        lambda p, x, k: ddim_inversion_captured(
+            unet_fn, p, scheduler, x, cond_src,
+            num_inference_steps=num_inference_steps,
+            cross_len=cross_len,
+            self_window=self_window,
+            capture_blend=ctx is not None and ctx.blend is not None,
+            dependent_weight=dependent_weight,
+            dependent_sampler=dependent_sampler,
+            key=k,
+        ),
+        params, latents, jax.random.key(0),
+    )
 
 
 def cached_fast_edit(
