@@ -158,18 +158,22 @@ def test_capture_alignment(sched, tiny):
     j = STEPS - 1 - i
     control = AttnControl(ctx=None, step_index=jnp.asarray(0), capture=True)
     _, store = fn(params, traj[j], jnp.asarray(ts_asc[j]), cond, control)
+    # maps are STORED in bf16 (models/attention.py capture sow): the scan vs
+    # eager programs' ~1e-6 fp drift can cross a bf16 rounding boundary, so
+    # agreement is to one bf16 ULP (~8e-3 near 1.0), not fp32 precision
     manual_cross = filter_site_tree(store["attn_base"], "attn2")
     got = jax.tree.map(lambda a: a[i], cached.cross_maps)
     jax.tree.map(
-        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5),
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-2),
         got, manual_cross,
     )
     manual_temp = filter_site_tree(store["attn_base"], "attn_temp")
     got_t = jax.tree.map(lambda a: a[i], cached.temporal_maps)
     jax.tree.map(
-        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5),
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-2),
         got_t, manual_temp,
     )
+    assert jax.tree.leaves(got)[0].dtype == jnp.bfloat16
 
 
 def test_out_of_window_base_maps_are_unused(ctx5):

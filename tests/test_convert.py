@@ -176,6 +176,16 @@ def test_vae_encode_decode_shapes():
     assert out.shape == video.shape
     assert np.isfinite(np.asarray(out)).all()
 
+    # sequential (lax.map) decode must match the unrolled loop exactly —
+    # including a non-dividing remainder (here 3 frames, chunk 2: one full
+    # chunk + a tail call) and under jit (its reason to exist: unrolled
+    # chunks schedule concurrently inside a larger program and stack their
+    # decoder temporaries)
+    out_seq = jax.jit(
+        lambda v, x: decode_video(model, v, x, chunk=2, sequential=True)
+    )(variables, z)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out_seq), atol=1e-5)
+
 
 def test_pipeline_dir_roundtrip(tmp_path, tiny_unet_params):
     """save_pipeline -> load_pipeline reproduces the UNet params and config

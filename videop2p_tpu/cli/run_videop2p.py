@@ -129,9 +129,10 @@ def main(
             ar_coeff=ar_coeff,
         )
 
-    # the reference keeps the Stage-2 UNet fp32 regardless of mixed_precision
-    # (run_videop2p.py:111-113) — scheduler/inversion math here is fp32 too;
-    # mixed_precision only sets the VAE/CLIP compute dtype
+    # mixed_precision sets the model compute dtype (the reference keeps the
+    # Stage-2 UNet fp32, run_videop2p.py:111-113 — the fp32 default here
+    # matches that); scheduler/latent math stays fp32 in every mode, which
+    # is what carries inversion fidelity and the cached replay's exactness
     dtype = {"fp16": jnp.bfloat16, "bf16": jnp.bfloat16, "fp32": jnp.float32,
              "no": jnp.float32}[mixed_precision]
     bundle = build_models(
@@ -163,11 +164,13 @@ def main(
     video = jnp.asarray(frames, jnp.float32)[None] / 127.5 - 1.0  # (1,F,H,W,3)
     with phase_timer("vae_encode"):
         # posterior mean, not a sample — inversion fidelity
-        # (image2latent_video, run_videop2p.py:530-537)
-        latents = encode_video(
-            bundle.vae, bundle.vae_params, video.astype(dtype), key, sample=False
-        )
-        latents = jax.block_until_ready(latents.astype(jnp.float32))
+        # (image2latent_video, run_videop2p.py:530-537); one jitted dispatch
+        latents = jax.jit(
+            lambda vp, vid, k: encode_video(
+                bundle.vae, vp, vid.astype(dtype), k, sample=False
+            ).astype(jnp.float32)
+        )(bundle.vae_params, video, key)
+        latents = jax.block_until_ready(latents)
     if device_mesh is not None:
         from videop2p_tpu.parallel import latent_sharding
 
@@ -298,6 +301,7 @@ def main(
     key, ik = jax.random.split(key)
     null_embeddings = None
     out = None
+    videos = None
     if use_cached:
         # capture + controlled denoise as ONE device program (the shared
         # pipelines.cached_fast_edit — the same program bench.py measures):
@@ -308,8 +312,11 @@ def main(
         print("Start Video-P2P!")
         t0 = time.time()
         with phase_timer("cached_invert_edit"):
-            traj, out = jax.jit(
-                lambda p, x, k: cached_fast_edit(
+            # capture-inversion + controlled edit + VAE decode, one program:
+            # the chunked decode alone is 4 host dispatches when run eagerly,
+            # each riding the tunnel
+            def fused_to_video(p, vp, x, k):
+                traj, edited = cached_fast_edit(
                     unet_fn, p, sched, x, cond_src, cond_all, uncond, ctx,
                     num_inference_steps=NUM_DDIM_STEPS,
                     guidance_scale=GUIDANCE_SCALE,
@@ -318,9 +325,14 @@ def main(
                     dependent_sampler=sampler if dep_w > 0 else None,
                     key=k,
                 )
-            )(params, latents, ik)
-            out = jax.block_until_ready(out)
-        print(f"[p2p] cached invert+edit done in {time.time() - t0:.1f}s")
+                vids = decode_video(bundle.vae, vp, edited.astype(dtype), sequential=True)
+                return traj, (vids.astype(jnp.float32) + 1) / 2
+
+            traj, videos = jax.jit(fused_to_video)(
+                params, bundle.vae_params, latents, ik
+            )
+            videos = np.asarray(jax.device_get(videos))
+        print(f"[p2p] cached invert+edit+decode done in {time.time() - t0:.1f}s")
         if reuse_inversion:
             save_inversion(
                 output_folder, inv_key, np.asarray(traj),
@@ -392,8 +404,8 @@ def main(
         jax.clear_caches()
 
     # ---- controlled denoise (skipped when the fused cached path already
-    # produced the output above) ------------------------------------------
-    if out is None:
+    # produced the decoded videos above) ----------------------------------
+    if videos is None:
         print("Start Video-P2P!")
         key, ek = jax.random.split(key)
         t0 = time.time()
@@ -414,9 +426,20 @@ def main(
             out = jax.block_until_ready(out)
         print(f"[p2p] controlled denoise done in {time.time() - t0:.1f}s")
 
-    with phase_timer("vae_decode"):
-        videos = decode_video(bundle.vae, bundle.vae_params, out.astype(dtype))
-        videos = np.asarray(jax.device_get((videos.astype(jnp.float32) + 1) / 2))
+        # drop the edit executable before compiling the decode program — at
+        # fp32 full scale the two do not fit the chip together
+        jax.clear_caches()
+        with phase_timer("vae_decode"):
+            # one jitted dispatch, rescale included
+            videos = jax.jit(
+                lambda vp, x: (
+                    decode_video(
+                        bundle.vae, vp, x.astype(dtype), sequential=True
+                    ).astype(jnp.float32)
+                    + 1
+                ) / 2
+            )(bundle.vae_params, out)
+            videos = np.asarray(jax.device_get(videos))
 
     # stream 0 = inversion reconstruction, stream 1 = edit
     # (run_videop2p.py:688-701; duration 250 ms/frame = 4 fps)
@@ -443,6 +466,11 @@ if __name__ == "__main__":
     parser.add_argument("--no_reuse_inversion", action="store_true",
                         help="do not persist/reuse inversion products "
                              "(trajectory + null embeddings) across runs")
+    parser.add_argument("--mixed_precision", type=str, default=None,
+                        choices=["fp32", "no", "fp16", "bf16"],
+                        help="model compute dtype (default fp32 = the "
+                             "reference's Stage-2 behavior; bf16 runs the "
+                             "MXU at full rate — ~3.5x faster end-to-end)")
     add_dependent_args(parser)
     args = parser.parse_args()
     # multi-host: join the process group before any device use (no-op on a
@@ -453,6 +481,8 @@ if __name__ == "__main__":
     cfg = load_config(args.config)
     # flags win over config for the keys both surfaces expose
     args.multi = args.multi or bool(cfg.pop("multi", False))
+    if args.mixed_precision is not None:
+        cfg["mixed_precision"] = args.mixed_precision
     args.mesh = args.mesh or cfg.pop("mesh", None)
     main(
         **cfg,

@@ -194,15 +194,35 @@ def encode_video(
 
 
 def decode_video(
-    vae: AutoencoderKL, params, latents: jax.Array, *, chunk: int = 4
+    vae: AutoencoderKL, params, latents: jax.Array, *, chunk: int = 4,
+    sequential: bool = False,
 ) -> jax.Array:
     """Scaled latents (B, F, h, w, 4) → video (B, F, 8h, 8w, 3) in [-1, 1],
-    decoded ``chunk`` frames at a time (pipeline_tuneavideo.py:243-246)."""
+    decoded ``chunk`` frames at a time (pipeline_tuneavideo.py:243-246).
+
+    ``sequential=True`` runs the chunks through ``lax.map`` — required when
+    the decode is traced INTO a larger jitted program: the unrolled chunks
+    have no data dependence, so XLA schedules them concurrently and their
+    decoder temporaries stack (~1 GB × n_chunks at fp32 512², an OOM on a
+    16 GB chip); the scan bounds peak memory to one chunk. Eager callers
+    keep the unrolled loop (separate dispatches already serialize it)."""
     b, f = latents.shape[:2]
     z = latents.reshape((b * f,) + latents.shape[2:]) / vae.config.scaling_factor
     n = z.shape[0]
-    outs = []
-    for i in range(0, n, chunk):
-        outs.append(vae.apply(params, z[i : i + chunk], method=vae.decode))
-    img = jnp.concatenate(outs, axis=0)
+    if sequential and n > chunk:
+        # full chunks through lax.map; a non-dividing remainder decodes as
+        # one tail call — it may overlap the map, so peak memory is at most
+        # TWO chunks' temporaries (vs all of them when fully unrolled)
+        full = (n // chunk) * chunk
+        zc = z[:full].reshape((full // chunk, chunk) + z.shape[1:])
+        img = jax.lax.map(lambda c: vae.apply(params, c, method=vae.decode), zc)
+        img = img.reshape((full,) + img.shape[2:])
+        if full < n:
+            tail = vae.apply(params, z[full:], method=vae.decode)
+            img = jnp.concatenate([img, tail], axis=0)
+    else:
+        outs = []
+        for i in range(0, n, chunk):
+            outs.append(vae.apply(params, z[i : i + chunk], method=vae.decode))
+        img = jnp.concatenate(outs, axis=0)
     return img.reshape((b, f) + img.shape[1:])
