@@ -400,7 +400,32 @@ def test_cached_three_prompts(sched, tiny):
     assert not np.allclose(np.asarray(out[1]), np.asarray(out[2]))
 
 
-def test_cached_rejects_incompatible_modes(sched, tiny):
+def test_fused_helper_matches_two_call_path(sched, tiny, ctx5):
+    """pipelines.cached_fast_edit (the ONE program the CLI jits and the
+    bench measures) must equal captured-inversion + cached-edit as separate
+    calls — same math, one dispatch."""
+    from videop2p_tpu.pipelines import cached_fast_edit
+
+    fn, params, cfg = tiny
+    x0 = jax.random.normal(jax.random.key(23), SHAPE)
+    cond = jax.random.normal(jax.random.key(24), (2, 77, cfg.cross_attention_dim))
+    uncond = jnp.zeros((77, cfg.cross_attention_dim))
+    c, sw = _windows(ctx5, STEPS)
+    traj, cached, out_two = _run_cached(fn, params, sched, x0, cond, uncond, ctx5, c, sw)
+    traj_f, out_f = jax.jit(
+        lambda p, x: cached_fast_edit(
+            fn, p, sched, x, cond[:1], cond, uncond, ctx5,
+            num_inference_steps=STEPS, cross_len=c, self_window=sw,
+        )
+    )(params, x0)
+    # fused trajectory == two-call trajectory (same walk, different XLA
+    # program; tolerance covers fusion-order fp drift)
+    np.testing.assert_allclose(np.asarray(traj_f), np.asarray(traj), atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(out_f[0]), np.asarray(x0[0]))
+    # blend_res differs between the helper (latent/4 rule) and _run_cached's
+    # explicit (4,4)? — the tiny 8×8 latent's rule resolves to the same (2,2)
+    # fallback site either way, so outputs must agree up to bf16-map rounding
+    np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_two), atol=2e-3)
     fn, params, cfg = tiny
     x0 = jax.random.normal(jax.random.key(11), SHAPE)
     cond = jax.random.normal(jax.random.key(12), (2, 77, cfg.cross_attention_dim))
