@@ -26,6 +26,7 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import subprocess
 import sys
 import tempfile
 import time
@@ -54,6 +55,87 @@ PEAK_FLOPS = {
     "tpu v4": 275e12,
     "tpu v6 lite": 918e12,  # v6e (Trillium)
 }
+
+
+def wait_for_backend(
+    *,
+    attempts: int = 5,
+    probe_timeouts_s: tuple = (120.0, 60.0, 60.0, 60.0, 60.0),
+    backoffs_s: tuple = (10.0, 20.0, 40.0, 60.0),
+    _probe=None,
+    _sleep=time.sleep,
+) -> bool:
+    """Bounded retry until the configured JAX backend is healthy.
+
+    Round 4's driver capture died at the FIRST device op
+    (``Unable to initialize backend 'axon': UNAVAILABLE``, BENCH_r04.json
+    rc=1) on a transiently-down chip — the same environment ran the r3 bench
+    and the builder's own run hours earlier. The probe runs ``jax.devices()``
+    in a SUBPROCESS, for two reasons: a hung backend init blocks forever
+    in-process (a timeout needs a killable child — the r4 judge's own
+    ``jax.devices()`` probe hung), and a *failed* init can be cached by the
+    parent's jax for the life of the process, so the parent must only ever
+    attempt it once the child has proven the backend healthy.
+
+    Returns True once a probe succeeds; False after ``attempts`` failures.
+    Total budget at the defaults: ~2.5 min when the backend FAILS fast
+    (five quick rc≠0 probes + 130 s of backoff), ~8 min worst case when it
+    HANGS (every probe burns its full timeout: 120+4×60 s + backoff — the
+    first probe gets the long leash because a *healthy* cold init can take
+    tens of seconds). Either way the bench then still emits its
+    machine-readable error line. Never raises.
+    """
+
+    def default_probe(timeout_s: float) -> bool:
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax, jax.numpy as jnp; "
+                 "jax.block_until_ready(jnp.zeros(8) + 1); "
+                 "print(jax.devices()[0].platform)"],
+                capture_output=True,
+                text=True,
+                timeout=timeout_s,
+            )
+        except (subprocess.TimeoutExpired, OSError):
+            return False
+        return proc.returncode == 0
+
+    for i in range(attempts):
+        if _probe is not None:
+            ok = _probe()
+        else:
+            ok = default_probe(probe_timeouts_s[min(i, len(probe_timeouts_s) - 1)])
+        if ok:
+            return True
+        if i < attempts - 1:
+            wait = backoffs_s[min(i, len(backoffs_s) - 1)]
+            print(
+                f"[bench] backend probe {i + 1}/{attempts} failed — "
+                f"retrying in {wait:.0f}s",
+                file=sys.stderr,
+                flush=True,
+            )
+            _sleep(wait)
+    return False
+
+
+def emit_backend_unavailable() -> None:
+    """The machine-readable record of a bench that could not run: the driver
+    parses the single stdout JSON line, so an unreachable backend must still
+    produce one (r4 produced only a traceback, leaving parsed:null)."""
+    print(
+        json.dumps(
+            {
+                "metric": "fast_edit_e2e_wall",
+                "value": None,
+                "unit": "s",
+                "vs_baseline": None,
+                "error": "backend_unavailable",
+            }
+        ),
+        flush=True,
+    )
 
 
 def _peak_flops() -> float:
@@ -199,7 +281,22 @@ def measure_with_floor(call, fresh_inputs, floor_s: float, what: str) -> Reading
                         file=sys.stderr,
                         flush=True,
                     )
-                    return Reading(out, max(span_s, floor_s), False, "device_trace", x)
+                    if span_s >= floor_s:
+                        return Reading(out, span_s, False, "device_trace", x)
+                    # the envelope span ITSELF is sub-floor: the sum cleared
+                    # the floor only via overlapping programs, so no single
+                    # trusted measurement of this phase exists. Report the
+                    # span as measured but SUSPECT — substituting the
+                    # theoretical floor here would record a number nothing
+                    # ever measured (round-4 advisor finding).
+                    print(
+                        f"[bench] {what}: trace span {span_s:.3f}s is itself "
+                        f"below the floor {floor_s:.2f}s — recording the span, "
+                        "flagged suspect",
+                        file=sys.stderr,
+                        flush=True,
+                    )
+                    return Reading(out, span_s, True, "device_trace", x)
                 print(
                     f"[bench] {what}: device trace total {dev_s:.3f}s is also "
                     f"sub-floor — flagging the reading as suspect",
@@ -411,6 +508,9 @@ def build_fast_edit_working_point(*, num_frames: int = 8, num_steps: int = 50,
 
 
 def main() -> None:
+    if not wait_for_backend():
+        emit_backend_unavailable()
+        return
     from videop2p_tpu.models import UNet3DConditionModel, UNet3DConfig
     from videop2p_tpu.pipelines import edit_sample, make_unet_fn, null_text_optimization
 

@@ -426,6 +426,12 @@ def test_fused_helper_matches_two_call_path(sched, tiny, ctx5):
     # explicit (4,4)? — the tiny 8×8 latent's rule resolves to the same (2,2)
     # fallback site either way, so outputs must agree up to bf16-map rounding
     np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_two), atol=2e-3)
+
+
+def test_cached_rejects_invalid_combinations(sched, tiny):
+    """cached_source is a fast-mode-only seam: official-mode CFG sources,
+    stochastic eta, and per-step null embeddings all contradict the captured
+    deterministic source stream and must be rejected loudly."""
     fn, params, cfg = tiny
     x0 = jax.random.normal(jax.random.key(11), SHAPE)
     cond = jax.random.normal(jax.random.key(12), (2, 77, cfg.cross_attention_dim))

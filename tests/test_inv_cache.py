@@ -1,0 +1,102 @@
+"""Inversion-product cache identity: the fingerprint must track CONTENT.
+
+VERDICT r4 item 8 / advisor: a fingerprint keyed on (relpath, size,
+mtime_ns) alone falsely HITS when bytes change under a preserved mtime
+(``rsync -t`` restores, archive extraction, ``cp -p`` of a same-size file)
+— silently replaying a stale inversion trajectory for different content.
+The round-5 fingerprint mixes a head/tail content sample per file.
+"""
+
+import os
+
+import numpy as np
+
+from videop2p_tpu.utils.inv_cache import (
+    content_fingerprint,
+    inversion_cache_key,
+    load_inversion,
+    save_inversion,
+)
+
+
+def _write(path, data: bytes, mtime_ns: int | None = None):
+    with open(path, "wb") as f:
+        f.write(data)
+    if mtime_ns is not None:
+        os.utime(path, ns=(mtime_ns, mtime_ns))
+
+
+def test_content_change_with_preserved_mtime_and_size_misses(tmp_path):
+    """The advisor's exact scenario: same path, same size, same mtime,
+    different bytes — the fingerprint MUST change."""
+    p = tmp_path / "weights.bin"
+    t = 1_700_000_000_000_000_000
+    _write(str(p), b"A" * 10_000, t)
+    fp_before = content_fingerprint(str(p))
+    _write(str(p), b"B" * 10_000, t)  # same size, mtime restored
+    assert content_fingerprint(str(p)) != fp_before
+
+
+def test_tail_only_change_in_large_file_misses(tmp_path):
+    """A >8 KiB file whose only change is in the LAST bytes (e.g. appended
+    optimizer state overwritten in place) must still miss."""
+    p = tmp_path / "shard.bin"
+    t = 1_700_000_000_000_000_000
+    blob = bytearray(os.urandom(1 << 20))
+    _write(str(p), bytes(blob), t)
+    fp_before = content_fingerprint(str(p))
+    blob[-1] ^= 0xFF
+    _write(str(p), bytes(blob), t)
+    assert content_fingerprint(str(p)) != fp_before
+
+
+def test_interior_only_change_in_large_file_misses(tmp_path):
+    """A structured checkpoint shard whose only change is a mid-file tensor
+    keeps its header and trailer bytes — the quarter-point samples must
+    catch it."""
+    p = tmp_path / "model.safetensors"
+    t = 1_700_000_000_000_000_000
+    blob = bytearray(os.urandom(1 << 20))
+    _write(str(p), bytes(blob), t)
+    fp_before = content_fingerprint(str(p))
+    mid = len(blob) // 2
+    blob[mid] ^= 0xFF  # one byte at the exact midpoint
+    _write(str(p), bytes(blob), t)
+    assert content_fingerprint(str(p)) != fp_before
+
+
+def test_identical_tree_fingerprints_stably(tmp_path):
+    d = tmp_path / "ckpt"
+    d.mkdir()
+    t = 1_700_000_000_000_000_000
+    _write(str(d / "a.bin"), b"aaaa", t)
+    _write(str(d / "b.bin"), b"bbbb", t)
+    assert content_fingerprint(str(d)) == content_fingerprint(str(d))
+
+
+def test_dir_fingerprint_ignores_own_results(tmp_path):
+    """Stage-2 writes results INSIDE the checkpoint dir; a run's own outputs
+    must not churn the key."""
+    d = tmp_path / "ckpt"
+    (d / "results_dpFalse").mkdir(parents=True)
+    _write(str(d / "w.bin"), b"w" * 100)
+    fp = content_fingerprint(str(d))
+    _write(str(d / "results_dpFalse" / "out.gif"), b"gif")
+    assert content_fingerprint(str(d)) == fp
+
+
+def test_missing_path_fingerprints_distinctly(tmp_path):
+    fp_missing = content_fingerprint(str(tmp_path / "nope"))
+    _write(str(tmp_path / "real.bin"), b"x")
+    assert content_fingerprint(str(tmp_path / "real.bin")) != fp_missing
+
+
+def test_roundtrip_and_key_sensitivity(tmp_path):
+    key = inversion_cache_key(clip="c", prompt="p", steps=50, ckpt="f1")
+    assert key != inversion_cache_key(clip="c", prompt="p", steps=50, ckpt="f2")
+    traj = np.arange(12, dtype=np.float32).reshape(3, 4)
+    save_inversion(str(tmp_path), key, traj)
+    hit = load_inversion(str(tmp_path), key, want_null=False)
+    assert hit is not None
+    np.testing.assert_array_equal(hit[0], traj)
+    assert load_inversion(str(tmp_path), "feedbeef00000000", want_null=False) is None

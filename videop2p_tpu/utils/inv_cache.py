@@ -29,16 +29,53 @@ __all__ = [
 ]
 
 
+_SAMPLE_BYTES = 4096
+
+
+def _content_sample(path: str, size: int) -> str:
+    """Hex digest of 4 KiB blocks at the file's head, tail, and quarter
+    points. mtime+size alone is not a content identity: tools that preserve
+    mtimes while changing bytes (``rsync -t`` restores, archive extraction,
+    mtime-restoring git hooks, ``cp -p`` over same-size files) would
+    otherwise produce a false cache hit and silently replay a stale
+    inversion trajectory for different content (round-4 advisor + VERDICT
+    item 8). Interior blocks matter too: a structured checkpoint shard
+    whose only change is one mid-file tensor keeps its header and trailer
+    bytes intact. ≤20 KiB of reads per file is cheap even for multi-GB
+    shards. (A sub-4 KiB interior change between sample points can still
+    collide — this is a fingerprint, not a full hash; ``--no_reuse_inversion``
+    is the escape hatch.)"""
+    h = hashlib.sha256()
+    offsets = sorted({
+        0,
+        max(size // 4 - _SAMPLE_BYTES // 2, 0),
+        max(size // 2 - _SAMPLE_BYTES // 2, 0),
+        max(3 * size // 4 - _SAMPLE_BYTES // 2, 0),
+        max(size - _SAMPLE_BYTES, 0),
+    })
+    try:
+        with open(path, "rb") as f:
+            for off in offsets:
+                f.seek(off)
+                h.update(f.read(_SAMPLE_BYTES))
+    except OSError:
+        return "<unreadable>"
+    return h.hexdigest()[:16]
+
+
 def content_fingerprint(path: str) -> str:
-    """Digest of a file tree's (relpath, size, mtime_ns) triples — a cheap
-    content identity for a checkpoint dir or a clip. Re-tuning a checkpoint
-    in place or swapping a clip's frames changes the fingerprint, so cache
-    keys built on it miss instead of silently reusing stale products.
-    Missing paths fingerprint as such (random-init smoke runs)."""
+    """Digest of a file tree's (relpath, size, mtime_ns, head/tail-sample)
+    tuples — a cheap content identity for a checkpoint dir or a clip.
+    Re-tuning a checkpoint in place or swapping a clip's frames changes the
+    fingerprint, so cache keys built on it miss instead of silently reusing
+    stale products — including when the change preserves mtimes (the
+    per-file content sample catches that case). Missing paths fingerprint
+    as such (random-init smoke runs)."""
     entries = []
     if os.path.isfile(path):
         st = os.stat(path)
-        entries.append((os.path.basename(path), st.st_size, st.st_mtime_ns))
+        entries.append((os.path.basename(path), st.st_size, st.st_mtime_ns,
+                        _content_sample(path, st.st_size)))
     elif os.path.isdir(path):
         for root, dirs, files in os.walk(path):
             # Stage-2 writes its results (GIFs, this cache) INSIDE the
@@ -54,10 +91,11 @@ def content_fingerprint(path: str) -> str:
                 except OSError:
                     continue
                 entries.append(
-                    (os.path.relpath(p, path), st.st_size, st.st_mtime_ns)
+                    (os.path.relpath(p, path), st.st_size, st.st_mtime_ns,
+                     _content_sample(p, st.st_size))
                 )
     else:
-        entries.append(("<missing>", 0, 0))
+        entries.append(("<missing>", 0, 0, ""))
     blob = json.dumps(sorted(entries))
     return hashlib.sha256(blob.encode()).hexdigest()[:16]
 
