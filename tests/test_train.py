@@ -121,9 +121,13 @@ def test_train_steps_scan_matches_sequential(tiny):
     )(state_scan, base)
     np.testing.assert_allclose(np.asarray(losses), np.asarray(seq_losses), rtol=1e-5)
     assert int(state_scan.step) == K
+    # scanned and unrolled programs fuse differently, and Adam's g/√v
+    # normalization amplifies last-ulp gradient differences while v̂ is
+    # still near zero — measured divergence is ~1.4e-6 after 4 steps
+    # (it was ~3e-7 with the pre-r5 flax GroupNorm's bf16-apply schedule)
     jax.tree.map(
         lambda a, b: np.testing.assert_allclose(
-            np.asarray(a), np.asarray(b), atol=1e-6
+            np.asarray(a), np.asarray(b), atol=5e-6
         ),
         state_scan.trainable, state_seq.trainable,
     )

@@ -76,6 +76,16 @@ def setup_mesh(bundle: "ModelBundle", mesh_spec: str, video_len: int):
         raise ValueError(f"sp axis {sp} must divide video_len {video_len}")
     device_mesh = make_mesh(shape)
     print(f"[mesh] data={dp} frames={sp} tensor={tp}")
+    if sp > 1 or tp > 1:
+        # a model-internal axis is sharded: pjit cannot partition Pallas
+        # custom calls, so force the XLA GroupNorm path (the fused kernel
+        # is the single-chip default; the sharded frame-attention sites get
+        # their own shard_map-wrapped kernel below)
+        import dataclasses as _dc
+
+        bundle.unet = bundle.unet.clone(
+            config=_dc.replace(bundle.unet.config, group_norm="xla")
+        )
     if sp > 1:
         # ring attention on the uncontrolled temporal sites (training /
         # inversion; controlled sites stay dense for the P2P edit), and the

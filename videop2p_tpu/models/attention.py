@@ -36,6 +36,7 @@ from flax import linen as nn
 from flax import struct
 
 from videop2p_tpu.control.controllers import ControlContext, control_attention
+from videop2p_tpu.models.layers import TpuGroupNorm
 
 __all__ = [
     "AttnControl",
@@ -344,6 +345,7 @@ class Transformer3DModel(nn.Module):
     depth: int = 1
     norm_groups: int = 32
     dtype: Dtype = jnp.float32
+    gn_impl: str = "auto"
     frame_attention_fn: Optional[Callable] = None
     temporal_attention_fn: Optional[Callable] = None
 
@@ -362,8 +364,9 @@ class Transformer3DModel(nn.Module):
         # frame (rearrange precedes self.norm, attention.py:94-101), whereas
         # GroupNorm on (B, F, H, W, C) would pool statistics across frames
         h = x.reshape(b * f, hh, ww, c)
-        h = nn.GroupNorm(
-            num_groups=self.norm_groups, epsilon=1e-6, dtype=self.dtype, name="norm"
+        h = TpuGroupNorm(
+            num_groups=self.norm_groups, epsilon=1e-6, dtype=self.dtype,
+            impl=self.gn_impl, name="norm",
         )(h)
         h = h.reshape(b, f, hh, ww, c)
         # use_linear_projection=False in SD1.x is a 1×1 conv — identical to a

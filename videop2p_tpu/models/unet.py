@@ -30,6 +30,7 @@ from videop2p_tpu.models.attention import AttnControl
 from videop2p_tpu.models.layers import (
     InflatedConv,
     TimestepEmbedding,
+    TpuGroupNorm,
     get_timestep_embedding,
 )
 from videop2p_tpu.models import unet_blocks
@@ -85,6 +86,12 @@ class UNet3DConfig:
     # frame-attention kernel: "auto"/"dense" (inference), "chunked"
     # (training: memory-bounded backward), "flash" (Pallas; see ops/attention.py)
     frame_attention: str = "auto"
+    # GroupNorm implementation: "auto" = one-pass fused Pallas kernel on TPU
+    # at VMEM-fitting sites (ops/groupnorm.py), "xla" = always the two-pass
+    # XLA math (the sharded-mesh path: pjit cannot partition a Pallas custom
+    # call — parallel/cli setup forces this when a model-internal axis is
+    # sharded), "interpret" = kernel in interpret mode (CPU tests)
+    group_norm: str = "auto"
 
     @classmethod
     def sd15(cls, **overrides) -> "UNet3DConfig":
@@ -208,6 +215,7 @@ class UNet3DConditionModel(nn.Module):
                 attn_heads=heads[i],
                 add_downsample=not is_final,
                 norm_groups=cfg.norm_num_groups,
+                gn_impl=cfg.group_norm,
                 dtype=self.dtype,
                 frame_attention_fn=frame_attention_fn,
                 temporal_attention_fn=self.temporal_attention_fn,
@@ -233,6 +241,7 @@ class UNet3DConditionModel(nn.Module):
             transformer_depth=depths[-1],
             attn_heads=heads[-1],
             norm_groups=cfg.norm_num_groups,
+            gn_impl=cfg.group_norm,
             dtype=self.dtype,
             frame_attention_fn=frame_attention_fn,
             temporal_attention_fn=self.temporal_attention_fn,
@@ -258,6 +267,7 @@ class UNet3DConditionModel(nn.Module):
                 attn_heads=rev_heads[i],
                 add_upsample=not is_final,
                 norm_groups=cfg.norm_num_groups,
+                gn_impl=cfg.group_norm,
                 dtype=self.dtype,
                 frame_attention_fn=frame_attention_fn,
                 temporal_attention_fn=self.temporal_attention_fn,
@@ -269,10 +279,9 @@ class UNet3DConditionModel(nn.Module):
                 x = block(x, res, temb)
 
         # --- out (unet.py:407-409) ---
-        x = nn.GroupNorm(
+        x = TpuGroupNorm(
             num_groups=cfg.norm_num_groups, epsilon=1e-5, dtype=self.dtype,
-            name="conv_norm_out",
+            act="silu", impl=cfg.group_norm, name="conv_norm_out",
         )(x)
-        x = nn.silu(x)
         x = InflatedConv(cfg.out_channels, dtype=self.dtype, name="conv_out")(x)
         return x

@@ -46,6 +46,7 @@ class CrossAttnDownBlock3D(nn.Module):
     attn_heads: int = 8
     add_downsample: bool = True
     norm_groups: int = 32
+    gn_impl: str = "auto"
     dtype: Dtype = jnp.float32
     frame_attention_fn: Optional[Callable] = None
     temporal_attention_fn: Optional[Callable] = None
@@ -62,13 +63,14 @@ class CrossAttnDownBlock3D(nn.Module):
         for i in range(self.num_layers):
             x = ResnetBlock3D(
                 self.out_channels, groups=self.norm_groups, dtype=self.dtype,
-                name=f"resnets_{i}",
+                gn_impl=self.gn_impl, name=f"resnets_{i}",
             )(x, temb)
             x = Transformer3DModel(
                 heads=self.attn_heads,
                 dim_head=self.out_channels // self.attn_heads,
                 depth=self.transformer_depth,
                 norm_groups=self.norm_groups,
+                gn_impl=self.gn_impl,
                 dtype=self.dtype,
                 frame_attention_fn=self.frame_attention_fn,
                 temporal_attention_fn=self.temporal_attention_fn,
@@ -88,6 +90,7 @@ class DownBlock3D(nn.Module):
     num_layers: int = 2
     add_downsample: bool = True
     norm_groups: int = 32
+    gn_impl: str = "auto"
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -98,7 +101,7 @@ class DownBlock3D(nn.Module):
         for i in range(self.num_layers):
             x = ResnetBlock3D(
                 self.out_channels, groups=self.norm_groups, dtype=self.dtype,
-                name=f"resnets_{i}",
+                gn_impl=self.gn_impl, name=f"resnets_{i}",
             )(x, temb)
             outputs.append(x)
         if self.add_downsample:
@@ -115,6 +118,7 @@ class UNetMidBlock3DCrossAttn(nn.Module):
     transformer_depth: int = 1
     attn_heads: int = 8
     norm_groups: int = 32
+    gn_impl: str = "auto"
     dtype: Dtype = jnp.float32
     frame_attention_fn: Optional[Callable] = None
     temporal_attention_fn: Optional[Callable] = None
@@ -128,7 +132,8 @@ class UNetMidBlock3DCrossAttn(nn.Module):
         control: Optional[AttnControl] = None,
     ) -> jax.Array:
         x = ResnetBlock3D(
-            self.channels, groups=self.norm_groups, dtype=self.dtype, name="resnets_0"
+            self.channels, groups=self.norm_groups, dtype=self.dtype,
+            gn_impl=self.gn_impl, name="resnets_0"
         )(x, temb)
         for i in range(self.num_layers):
             x = Transformer3DModel(
@@ -136,6 +141,7 @@ class UNetMidBlock3DCrossAttn(nn.Module):
                 dim_head=self.channels // self.attn_heads,
                 depth=self.transformer_depth,
                 norm_groups=self.norm_groups,
+                gn_impl=self.gn_impl,
                 dtype=self.dtype,
                 frame_attention_fn=self.frame_attention_fn,
                 temporal_attention_fn=self.temporal_attention_fn,
@@ -143,7 +149,7 @@ class UNetMidBlock3DCrossAttn(nn.Module):
             )(x, context=context, control=control)
             x = ResnetBlock3D(
                 self.channels, groups=self.norm_groups, dtype=self.dtype,
-                name=f"resnets_{i + 1}",
+                gn_impl=self.gn_impl, name=f"resnets_{i + 1}",
             )(x, temb)
         return x
 
@@ -158,6 +164,7 @@ class CrossAttnUpBlock3D(nn.Module):
     attn_heads: int = 8
     add_upsample: bool = True
     norm_groups: int = 32
+    gn_impl: str = "auto"
     dtype: Dtype = jnp.float32
     frame_attention_fn: Optional[Callable] = None
     temporal_attention_fn: Optional[Callable] = None
@@ -175,13 +182,14 @@ class CrossAttnUpBlock3D(nn.Module):
             x = jnp.concatenate([x, res_samples[-(i + 1)]], axis=-1)
             x = ResnetBlock3D(
                 self.out_channels, groups=self.norm_groups, dtype=self.dtype,
-                name=f"resnets_{i}",
+                gn_impl=self.gn_impl, name=f"resnets_{i}",
             )(x, temb)
             x = Transformer3DModel(
                 heads=self.attn_heads,
                 dim_head=self.out_channels // self.attn_heads,
                 depth=self.transformer_depth,
                 norm_groups=self.norm_groups,
+                gn_impl=self.gn_impl,
                 dtype=self.dtype,
                 frame_attention_fn=self.frame_attention_fn,
                 temporal_attention_fn=self.temporal_attention_fn,
@@ -199,6 +207,7 @@ class UpBlock3D(nn.Module):
     num_layers: int = 3
     add_upsample: bool = True
     norm_groups: int = 32
+    gn_impl: str = "auto"
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -212,7 +221,7 @@ class UpBlock3D(nn.Module):
             x = jnp.concatenate([x, res_samples[-(i + 1)]], axis=-1)
             x = ResnetBlock3D(
                 self.out_channels, groups=self.norm_groups, dtype=self.dtype,
-                name=f"resnets_{i}",
+                gn_impl=self.gn_impl, name=f"resnets_{i}",
             )(x, temb)
         if self.add_upsample:
             x = Upsample3D(self.out_channels, dtype=self.dtype, name="upsample")(x)
