@@ -675,6 +675,36 @@ def main() -> None:
                     derived=(r_ledit,),
                 )
 
+            # ---- cached-vs-live output delta (VERDICT r4 item 2): the ONE
+            # quantified number for the cached mode's disclosed
+            # approximation (pipelines/cached.py:27-33 — the captured base
+            # maps come from the inversion trajectory's positions, one
+            # trajectory's worth off the live source stream's). Same input
+            # through both paths at the bench working point; the EDITED
+            # stream's latent delta is the metric (stream 0 differs by
+            # design: cached replays exactly, live only approximately
+            # reconstructs). Weights are random-init — the architecture and
+            # shapes are the working point's; a checkpoint-weighted delta
+            # would need SD weights this image doesn't ship (disclosed). --
+            x_cmp = jax.random.normal(jax.random.fold_in(base, 91), x0.shape, x0.dtype)
+            out_live_cmp = hard_block(wp.edit(params, wp.invert(params, x_cmp)[-1]))
+            out_cch_cmp = hard_block(wp.e2e_cached(params, x_cmp))
+            dl = jnp.abs(out_cch_cmp[1].astype(jnp.float32)
+                         - out_live_cmp[1].astype(jnp.float32))
+            ref_scale = float(jnp.mean(jnp.abs(out_live_cmp[1].astype(jnp.float32))))
+            rec.record("cached_vs_live_edit_max_abs_delta",
+                       round(float(jnp.max(dl)), 4))
+            rec.record("cached_vs_live_edit_mean_abs_delta",
+                       round(float(jnp.mean(dl)), 5))
+            rec.record("cached_vs_live_edit_mean_abs_latent", round(ref_scale, 4))
+            ds = jnp.abs(out_cch_cmp[0].astype(jnp.float32)
+                         - out_live_cmp[0].astype(jnp.float32))
+            # stream 0: cached is bit-exact to x_0; this delta IS the live
+            # path's reconstruction drift, recorded for context
+            rec.record("cached_vs_live_source_max_abs_delta",
+                       round(float(jnp.max(ds)), 4))
+            del out_live_cmp, out_cch_cmp, dl, ds
+
             # The BASELINE.json north-star (<10 s) is a v5e-4 slice; this
             # harness has ONE chip. The projection models the LIVE sharded
             # path (the cached capture is single-chip for now), so it feeds
@@ -684,6 +714,8 @@ def main() -> None:
                 project = _tools_import("projection").project
                 proj = project(inv_live_s, edit_live_s, steps=STEPS, frames=F)
                 rec.record("projected_v5e4_s", proj["projected_v5e4_s"],
+                           derived=(r_linv, r_ledit))
+                rec.record("projected_v5e4_range_s", proj["projected_v5e4_range_s"],
                            derived=(r_linv, r_ledit))
                 rec.record("projected_v5e4_efficiency", proj["parallel_efficiency"],
                            derived=(r_linv, r_ledit))
@@ -765,6 +797,8 @@ def main() -> None:
                                 shard_edit_s=r_sedit.seconds)
                 rec.record("projected_v5e4_s", proj["projected_v5e4_s"],
                            derived=(r_linv, r_ledit, r_sinv, r_sedit))
+                rec.record("projected_v5e4_range_s", proj["projected_v5e4_range_s"],
+                           derived=(r_linv, r_ledit, r_sinv, r_sedit))
                 rec.record("projected_v5e4_efficiency", proj["parallel_efficiency"],
                            derived=(r_linv, r_ledit, r_sinv, r_sedit))
                 rec.record("projected_v5e4_model",
@@ -795,10 +829,15 @@ def main() -> None:
             INNER_FIXED = 3
 
             def null_opt(p, tr, *, inner, early_stop):
+                # return_losses: the final inner-loop reconstruction loss per
+                # outer step is the optimization objective itself — the
+                # direct parity metric between this fixed-work variant and
+                # the reference-faithful early-stopped run measured LAST
                 return null_text_optimization(
                     fn_remat, p, sched, tr, cond[:1], uncond[None],
                     num_inference_steps=STEPS, guidance_scale=7.5, outer_chunk=10,
                     num_inner_steps=inner, early_stop=early_stop,
+                    return_losses=True,
                 )
 
             # no separate warm run: the chunk program loads from the
@@ -813,12 +852,22 @@ def main() -> None:
                 (2 + 3 * INNER_FIXED) * STEPS * F * FLOPS_PER_FRAME_FWD / peak,
                 "null-text fixed",
             )
-            null_seq, nfix_s = r_nfix.out, r_nfix.seconds
+            (null_seq, nfix_losses), nfix_s = r_nfix.out, r_nfix.seconds
             rec.record("null_text_fixed3_s", round(nfix_s, 3), reading=r_nfix)
             rec.record("null_text_inner_step_ms",
                        round(nfix_s / (STEPS * INNER_FIXED) * 1e3, 1),
                        derived=(r_nfix,))
+            # reconstruction-parity evidence, part 1: the final inner-loop
+            # loss per outer step IS the optimization objective
+            # (‖x̂_{t-1} − x_{t-1}‖², run_videop2p.py:596) — comparable to
+            # the early-stopped variant's losses recorded at the end
+            nfl = nfix_losses.astype(jnp.float32)
+            rec.record("null_fixed3_recon_loss_mean",
+                       float(jnp.mean(nfl)), derived=(r_nfix,))
+            rec.record("null_fixed3_recon_loss_max",
+                       float(jnp.max(nfl)), derived=(r_nfix,))
             null_traj_last = r_nfix.x_used[-1]
+            null_traj_x0 = r_nfix.x_used[0]  # trajectory[0] is x_0
             jax.clear_caches()
 
             # official-mode controlled edit (full CFG + per-step null
@@ -841,6 +890,53 @@ def main() -> None:
             )
             out_off, edit_off_s = r_off.out, r_off.seconds
             rec.record("official_edit_s", round(edit_off_s, 3), reading=r_off)
+            # reconstruction-parity evidence, part 2: the official edit's
+            # stream 0 is the CFG reconstruction driven by the fixed-3 null
+            # embeddings — its MSE/PSNR against the inversion input x_0 is
+            # the end-to-end reconstruction quality of the fixed-work
+            # variant. Only valid when the ACCEPTED attempt ran on the
+            # fixed-3 trajectory's own x_T (measure_with_floor can accept a
+            # retry on warm_last+0.001, whose x_0 is a different latent —
+            # the MSE would then compare unrelated reconstructions); the
+            # sub-floor-retry case recomputes on the right input outside
+            # the timing window.
+            if r_off.x_used is null_traj_last:
+                recon = out_off[0]
+            else:
+                recon = hard_block(
+                    edit_official(params, null_traj_last, null_seq)
+                )[0]
+            rec_mse = float(jnp.mean(
+                (recon.astype(jnp.float32)
+                 - null_traj_x0[0].astype(jnp.float32)) ** 2
+            ))
+            rec.record("official_fixed3_recon_mse", round(rec_mse, 6),
+                       derived=(r_off, r_nfix))
+            import math as _math
+
+            span = float(
+                jnp.max(null_traj_x0.astype(jnp.float32))
+                - jnp.min(null_traj_x0.astype(jnp.float32))
+            )
+            rec.record(
+                "official_fixed3_recon_psnr_db",
+                round(10 * _math.log10(span * span / max(rec_mse, 1e-12)), 2),
+                derived=(r_off, r_nfix),
+            )
+            del recon
+            # the official-mode number OF RECORD uses the fixed-work null
+            # variant: deterministic wall-clock (the early-stopped run
+            # spread 157–418 s with the weight-dependent stop point across
+            # r3/r4 records) with the parity evidence above and the
+            # early-stop A/B below. VERDICT r4 item 4.
+            official_fixed = inv_live_s + nfix_s + edit_off_s
+            rec.record("official_edit_e2e_s", round(official_fixed, 3),
+                       derived=(r_linv, r_nfix, r_off))
+            rec.record("official_null_variant",
+                       f"fixed {INNER_FIXED} inner steps, no early stop")
+            rec.record("official_vs_baseline",
+                       round(V100_OFFICIAL_EDIT_S / official_fixed, 2),
+                       derived=(r_linv, r_nfix, r_off))
 
             # Stage-1 tuning step on a cleared chip (its grad program +
             # optimizer state need the HBM to themselves)
@@ -858,25 +954,34 @@ def main() -> None:
                 dtype=jnp.bfloat16,
             )
             fn_r = make_unet_fn(model_train)
+            # the state's param buffers must be COPIES: steps_fn donates its
+            # input state, and the original `params` tree is still used by
+            # the long-video and early-stop phases below — donating shared
+            # buffers would invalidate them
             state = TrainState.create(
-                {k: v for k, v in params["params"].items()}, tx,
-                tune_cfg.trainable_modules,
+                jax.tree.map(jnp.copy, {k: v for k, v in params["params"].items()}),
+                tx, tune_cfg.trainable_modules,
             )
             ddpm = DDPMScheduler.create_sd()
             k3, k4, k5 = jax.random.split(jax.random.fold_in(base, 99), 3)
             lat_train = jax.random.normal(k3, (1, F, 64, 64, 4))
-            # the production path (cli/run_tuning.py, steps_per_call=25):
+            # the production path (cli/run_tuning.py, steps_per_call=100):
             # TRAIN_STEPS steps as ONE scanned device program. Per-step host
             # dispatch through the tunnel cost ~2× the device step time as a
-            # Python loop (r4 device trace: 384 ms/step vs 456-794 ms wall),
-            # and the single-call fixed overhead (~1.3 s) needs ≥25 steps to
-            # amortize (measured: K=5 → 640 ms/step, K=25 → 388 ms/step)
-            TRAIN_STEPS = 25
+            # Python loop (r4 device trace: 384 ms/step vs 456-794 ms wall);
+            # the single-call fixed overhead is ~1.3 s, so the recorded
+            # per-step rate is device + 1300/K ms — K=25 read 437 ms against
+            # the 388 ms device floor; K=100 amortizes to ~401 ms and stays
+            # a ~40 s call, inside the execution watchdog. The state is
+            # DONATED: the carry tree (params + Adam moments) would
+            # otherwise be held twice (in + out) and copied.
+            TRAIN_STEPS = 100
             steps_fn = jax.jit(
                 lambda s, k: train_steps(
                     fn_r, tx, s, ddpm, lat_train, cond[:1], k,
                     num_steps=TRAIN_STEPS,
-                )
+                ),
+                donate_argnums=(0,),
             )
             state, _ = steps_fn(state, k4)  # compile + first chunk
             hard_block(state.trainable)
@@ -913,32 +1018,51 @@ def main() -> None:
             # 24 frames; the 32-frame edit is the v5e-8 case): 24-frame fast
             # edit on ONE chip with the fused Pallas kernel (dense frame
             # attention cannot run here — the 64²-site scores alone are
-            # 3·24·8·4096² bf16 ≈ 19 GB > HBM). Run at 10 DDIM steps to fit
-            # the driver's budget: per-step time is step-count-independent
-            # (identical per-step program inside the scan), so the 50-step
-            # number is the measured per-step rate × 50, recorded as
-            # *_extrapolated. r3 measured the full 50 steps at 50.232 s;
-            # the extrapolation reproduces it to within run noise.
-            F_LONG, STEPS_LONG = 24, 10
-            wl = build_fast_edit_working_point(
-                num_frames=F_LONG, num_steps=STEPS_LONG, frame_attention="auto"
-            )
-            hard_block(wl.edit(wl.params, wl.invert(wl.params, wl.x_warm)[-1]))
-            r_long = measure_with_floor(
-                lambda x: wl.edit(wl.params, wl.invert(wl.params, x)[-1]),
-                [wl.x0, wl.x0 + 0.001],  # value-fresh per attempt
-                4 * F_LONG * STEPS_LONG * FLOPS_PER_FRAME_FWD / peak,  # 1+3 streams
-                "long24",
-            )
+            # 3·24·8·4096² bf16 ≈ 19 GB > HBM). Measured for REAL at 50
+            # steps (VERDICT r4 item 5 — r4's 10-step extrapolation must not
+            # replace a measurement of record), CACHED mode first: capture
+            # maps scale linearly with frames (~3.1 GiB at 8f → ~9.3 GiB at
+            # 24f) and should fit next to the bf16 params; a
+            # RESOURCE_EXHAUSTED falls back to the live 3-stream path, and
+            # the record says which mode ran.
+            F_LONG = 24
+            long_mode = "cached"
+            try:
+                wl = build_fast_edit_working_point(
+                    num_frames=F_LONG, num_steps=STEPS, cached=True
+                )
+                hard_block(wl.e2e_cached(wl.params, wl.x_warm))
+                r_long = measure_with_floor(
+                    lambda x: wl.e2e_cached(wl.params, x),
+                    [wl.x0, wl.x0 + 0.001],  # value-fresh per attempt
+                    # 1-stream capture inversion + 2-stream cached edit
+                    3 * F_LONG * STEPS * FLOPS_PER_FRAME_FWD / peak,
+                    "long24 cached e2e",
+                )
+            except Exception as e:  # noqa: BLE001 — OOM → live fallback
+                print(f"[bench] long24 cached mode failed ({type(e).__name__}) "
+                      "— measuring the live path", file=sys.stderr, flush=True)
+                long_mode = "live"
+                jax.clear_caches()
+                wl = build_fast_edit_working_point(
+                    num_frames=F_LONG, num_steps=STEPS, frame_attention="auto"
+                )
+                hard_block(wl.edit(wl.params, wl.invert(wl.params, wl.x_warm)[-1]))
+                r_long = measure_with_floor(
+                    lambda x: wl.edit(wl.params, wl.invert(wl.params, x)[-1]),
+                    [wl.x0, wl.x0 + 0.001],
+                    4 * F_LONG * STEPS * FLOPS_PER_FRAME_FWD / peak,  # 1+3 streams
+                    "long24 live e2e",
+                )
             out_long, long_s = r_long.out, r_long.seconds
             assert bool(jnp.isfinite(out_long.astype(jnp.float32)).all())
-            long_50 = long_s * STEPS / STEPS_LONG
-            rec.record("long24_fast_edit_10step_s", round(long_s, 3), reading=r_long)
-            rec.record("long24_fast_edit_e2e_s_extrapolated", round(long_50, 3),
+            rec.record("long24_fast_edit_e2e_s", round(long_s, 3), reading=r_long)
+            rec.record("long24_mode", long_mode)
+            rec.record("long24_frames_per_sec", round(F_LONG / long_s, 3),
                        derived=(r_long,))
-            rec.record("long24_frames_per_sec", round(F_LONG / long_50, 3),
-                       derived=(r_long,))
-            rec.drop("long24_fast_edit_e2e_s")  # renamed *_extrapolated
+            rec.drop("long24_fast_edit_e2e_s_extrapolated")  # measured now
+            rec.drop("long24_fast_edit_10step_s")
+            r_long = r_long._replace(out=None)
             del out_long, wl
             jax.clear_caches()
 
@@ -1056,7 +1180,7 @@ def main() -> None:
                 2 * STEPS * F * FLOPS_PER_FRAME_FWD / peak,
                 "null-text",
             )
-            null_s = r_null.seconds
+            (_, es_losses), null_s = r_null.out, r_null.seconds
             rec.record("null_text_wall_s", round(null_s, 3), reading=r_null)
             # no warm execution precedes this phase (a second full run costs
             # 157–418 s of driver budget): on a cold compile cache the
@@ -1064,11 +1188,18 @@ def main() -> None:
             # reading. That only overstates our time (conservative for every
             # derived speedup); recorded so the provenance is machine-readable
             rec.record("null_text_warm", "none — may include compile-cache load")
-            official = inv_live_s + null_s + edit_off_s
-            rec.record("official_edit_e2e_s", round(official, 3),
-                       derived=(r_linv, r_null, r_off))
-            rec.record("official_vs_baseline",
-                       round(V100_OFFICIAL_EDIT_S / official, 2),
+            # reconstruction-parity evidence, part 3: the early-stopped
+            # variant's final losses on the SAME objective — the ratio to
+            # the fixed-3 losses is the disclosed parity bound of the
+            # official-mode record above
+            esl = es_losses.astype(jnp.float32)
+            rec.record("null_earlystop_recon_loss_mean",
+                       float(jnp.mean(esl)), derived=(r_null,))
+            rec.record("null_recon_loss_ratio_fixed3_vs_earlystop",
+                       round(float(jnp.mean(nfl) / jnp.maximum(jnp.mean(esl), 1e-12)), 3),
+                       derived=(r_nfix, r_null))
+            official_es = inv_live_s + null_s + edit_off_s
+            rec.record("official_edit_e2e_earlystop_s", round(official_es, 3),
                        derived=(r_linv, r_null, r_off))
             del r_null, traj, warm_traj, traj_extra
             jax.clear_caches()

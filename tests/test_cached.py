@@ -428,6 +428,77 @@ def test_fused_helper_matches_two_call_path(sched, tiny, ctx5):
     np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_two), atol=2e-3)
 
 
+def test_cached_vs_live_controlled_delta_tracks_source_drift(sched, tiny, ctx5):
+    """Quantify the cached-mode approximation WITH controllers (VERDICT r4
+    item 2). The only input difference between the two paths is the source
+    stream: cached replays the inversion trajectory exactly, live re-predicts
+    from a drifting latent (pipeline_tuneavideo.py:412-415) — so the edited
+    streams' divergence must be DRIVEN BY (and bounded by a small multiple
+    of) the live source's reconstruction drift. With random weights that
+    drift is large (DDIM inversion's linearization assumes a trained ε-model),
+    which is exactly why the bound is relative, not absolute; bench.py
+    records the same pair of numbers at SD scale
+    (cached_vs_live_edit_max_abs_delta / cached_vs_live_source_max_abs_delta).
+    """
+    fn, params, cfg = tiny
+    x0 = jax.random.normal(jax.random.key(40), SHAPE)
+    cond = jax.random.normal(jax.random.key(41), (2, 77, cfg.cross_attention_dim))
+    uncond = jnp.zeros((77, cfg.cross_attention_dim))
+    c, sw = _windows(ctx5, STEPS)
+    traj, cached, out_c = _run_cached(fn, params, sched, x0, cond, uncond, ctx5, c, sw)
+    out_l = jax.jit(
+        lambda p, xt: edit_sample(
+            fn, p, sched, xt, cond, uncond,
+            num_inference_steps=STEPS, ctx=ctx5, source_uses_cfg=False,
+            blend_res=(4, 4),
+        )
+    )(params, traj[-1])
+    edit_delta = float(np.abs(np.asarray(out_c[1], np.float32)
+                              - np.asarray(out_l[1], np.float32)).max())
+    source_drift = float(np.abs(np.asarray(out_c[0], np.float32)
+                                - np.asarray(out_l[0], np.float32)).max())
+    # cached stream 0 is exact (pinned elsewhere), so source_drift IS the
+    # live path's reconstruction error; the edit delta rides it through the
+    # shared base maps. Measured at this seed: delta ~16, drift ~7.7.
+    assert source_drift > 0.0
+    assert edit_delta <= 5.0 * source_drift + 1e-3, (
+        f"edit delta {edit_delta} not explained by source drift {source_drift}"
+    )
+
+
+def test_maps_budget_gate_scales_to_long_video(sched, tiny, ctx5):
+    """The per-chip HBM gate (pipelines.fast.maps_budget_decision — the
+    CLI's gate) must make the 24-frame long-video config take the cached
+    path on a frame-sharded slice while a budget-limited single chip falls
+    back to live: capture bytes grow ~linearly with frames, and shard over
+    the sp axis. Shapes only (eval_shape) — no compute."""
+    from videop2p_tpu.pipelines.fast import capture_shapes, maps_budget_decision
+
+    fn, params, cfg = tiny
+    c, sw = _windows(ctx5, STEPS)
+    cond = jax.random.normal(jax.random.key(50), (2, 77, cfg.cross_attention_dim))
+
+    def shapes_for(frames):
+        x = jnp.zeros((1, frames, 8, 8, 4))
+        return capture_shapes(
+            fn, params, sched, x, cond[:1], ctx5,
+            num_inference_steps=STEPS, cross_len=c, self_window=sw,
+        )[1]
+
+    s8, s24 = shapes_for(8), shapes_for(24)
+    _, gb8, _ = maps_budget_decision(s8)
+    _, gb24, _ = maps_budget_decision(s24)
+    assert 2.0 < gb24 / gb8 < 4.0  # ~linear in frames
+
+    # a budget sized between per-chip(sp=4) and global: single chip falls
+    # back, the 4-way frame shard takes the cached path
+    budget = gb24 / 2
+    fits1, _, per1 = maps_budget_decision(s24, sp=1, budget_gb=budget)
+    fits4, _, per4 = maps_budget_decision(s24, sp=4, budget_gb=budget)
+    assert not fits1 and fits4
+    assert per4 == pytest.approx(per1 / 4)
+
+
 def test_cached_rejects_invalid_combinations(sched, tiny):
     """cached_source is a fast-mode-only seam: official-mode CFG sources,
     stochastic eta, and per-step null embeddings all contradict the captured

@@ -104,8 +104,37 @@ def project(
     proj_inv = (shard_inv_s if use_shard else inv_s / sp / efficiency) + coll_inv
     proj_edit = (shard_edit_s if use_shard else edit_s / sp / efficiency) + coll_edit
     total = proj_inv + proj_edit
+
+    # Uncertainty band (VERDICT r4 item 6: the point estimate moved 20 % in
+    # one round when the compute model switched from linear-in-sp to the
+    # measured shard proxy — so the record carries BOTH models at both
+    # bandwidth extremes, not three significant figures of one of them).
+    #   optimistic  = linear compute scaling (ignores small-batch loss; the
+    #                 r3 model) at 2× the default effective ICI bandwidth;
+    #   pessimistic = the measured F/sp shard proxy (includes small-batch
+    #                 loss AND the harness's tunnel timing noise — the
+    #                 proxy phases are 2-4 s where ±0.3 s is ~15 %) at half
+    #                 the default bandwidth.
+    # The true 4-chip number should land inside; quote the range.
+    candidates = []
+    for bw in (ici_gbps / 2, ici_gbps, ici_gbps * 2):
+        ci = inv_mb * 1e6 / (bw * 1e9) * steps
+        ce = edit_mb * 1e6 / (bw * 1e9) * steps
+        candidates.append(inv_s / sp + ci + edit_s / sp + ce)  # linear, ideal
+        if efficiency < 1.0:
+            # derated linear — the compute model configs without their own
+            # shard proxy actually use; without this the point estimate
+            # could sit outside its own range
+            candidates.append(
+                inv_s / sp / efficiency + ci + edit_s / sp / efficiency + ce
+            )
+        if use_shard:
+            candidates.append(shard_inv_s + ci + shard_edit_s + ce)
+    lo, hi = min(candidates), max(candidates)
+
     return {
         "projected_v5e4_s": round(total, 2),
+        "projected_v5e4_range_s": [round(lo, 1), round(hi, 1)],
         "parallel_efficiency": round((inv_s + edit_s) / (sp * total), 3),
         "assumptions": {
             "sp": sp,
@@ -265,11 +294,31 @@ def main() -> None:
     p = project(inv_s, edit_s, **shard_kw)
     lines += [
         "",
-        "Run-to-run note: the 2-frame proxy phases are short (~2-4 s) and",
-        "carry tunnel timing variance. Historical spread with identical",
-        "code: 6.84 s @ 0.62 and 5.91 s @ 0.72 across measured rounds —",
-        "both satisfy the <10 s target. The bolded projection above uses",
-        "the latest recorded readings.",
+        "## Uncertainty: why the point estimate moved between rounds, and",
+        "the range that replaces it",
+        "",
+        "The recorded efficiency swung 0.948 (r3) → 0.765 (r4) when the",
+        "per-chip compute model switched from *linear-in-sp* (single-chip",
+        "time ÷ 4 — assumes zero small-batch loss) to the *measured shard",
+        "proxy* (the F/4-frame working point run on one chip — includes",
+        "real small-batch loss AND the harness's tunnel timing noise: the",
+        "proxy phases are 2–4 s, where the observed ±0.3 s run-to-run",
+        "wobble is ~15 %). Neither model is wrong; they bracket the truth:",
+        "linear is the optimistic bound (a real mesh hides some per-chip",
+        "overhead under collectives), the proxy is the pessimistic bound",
+        "(tunnel noise inflates short readings, and the proxy cannot",
+        "overlap what a real mesh overlaps). The projection of record is",
+        "therefore a RANGE over {both compute models} × {0.5×, 1×, 2× the",
+        "conservative 100 GB/s effective ICI bandwidth}, and claims should",
+        "quote the range, not three significant figures of either point:",
+        "",
+        f"**Range: {p['projected_v5e4_range_s'][0]}–"
+        f"{p['projected_v5e4_range_s'][1]} s** for the live fast edit.",
+        "",
+        "North-star check (BASELINE.md: <10 s on v5e-4): evaluated at the",
+        f"PESSIMISTIC end of the range — {p['projected_v5e4_range_s'][1]} s "
+        + ("satisfies" if p["projected_v5e4_range_s"][1] < 10 else "MISSES")
+        + " the target.",
     ]
     lines += [
         "",
@@ -329,9 +378,14 @@ def main() -> None:
             inv_s, bd["null_text_fixed3_s"], bd["official_edit_s"],
             efficiency=eff,
         )
-    long_key = "long24_fast_edit_e2e_s_extrapolated"
-    if long_key in bd:
-        out["long24_fast_edit"] = project_long(bd[long_key], efficiency=eff)
+    # r5 renamed the measured key (the 10-step extrapolation was retired);
+    # keep the fallback so pre-r5 records still project
+    long_s = bd.get("long24_fast_edit_e2e_s",
+                    bd.get("long24_fast_edit_e2e_s_extrapolated"))
+    if long_s is not None:
+        out["long24_fast_edit"] = project_long(long_s, efficiency=eff)
+        if "long24_mode" in bd:
+            out["long24_fast_edit"]["assumptions"]["measured_mode"] = bd["long24_mode"]
     if "shard2_samples" in bd:
         out["shard_proxy_samples"] = bd["shard2_samples"]
     with open(os.path.join(docs, "projection_v5e4.json"), "w") as f:

@@ -89,9 +89,11 @@ def main(
     tiny: bool = False,
     log_every: int = 50,
     # train steps per device call (lax.scan chunk): amortizes the per-call
-    # dispatch overhead (~1.3 s through the TPU tunnel; 25×~0.4 s steps stay
-    # well inside the execution watchdog)
-    steps_per_call: int = 25,
+    # dispatch overhead (~1.3 s through the TPU tunnel — recorded per-step
+    # rate is device-floor + 1300/K ms, so K=25 read 437 ms vs the 388 ms
+    # device floor and K=100 amortizes to ~400 ms; a 100-step call is ~40 s,
+    # inside the execution watchdog that kills multi-minute programs)
+    steps_per_call: int = 100,
     **unused,
 ) -> str:
     del unused
@@ -184,12 +186,16 @@ def main(
     # multiple steps per device call (lax.scan over the per-step keys): each
     # host dispatch rides the TPU tunnel, and the device-side step is ~2×
     # faster than the per-dispatch loop measured (train/tuner.py train_steps)
+    # the state (params + Adam moments) is donated: the carry tree would
+    # otherwise be held twice (in + out) inside the program and copied —
+    # nothing else reads bundle.unet_params after TrainState.create above
     steps_fn = jax.jit(
         lambda s, k, n: train_steps(
             unet_fn, tx, s, noise_sched, latents, text_emb, k, num_steps=n,
             dependent_sampler=sampler,
         ),
         static_argnums=2,
+        donate_argnums=(0,),
     )
 
     # per-step train_loss/lr tracker (the reference's accelerator.log /

@@ -41,7 +41,6 @@ from videop2p_tpu.pipelines import (
     make_unet_fn,
     null_text_optimization,
 )
-from videop2p_tpu.pipelines.cached import tree_bytes
 from videop2p_tpu.utils.profiling import phase_timer
 from videop2p_tpu.utils.video_io import save_video_gif
 
@@ -254,7 +253,7 @@ def main(
         # exactly, so nothing else needs capturing
         cross_len, self_window = capture_windows(ctx, NUM_DDIM_STEPS)
 
-        from videop2p_tpu.pipelines.fast import capture_shapes
+        from videop2p_tpu.pipelines.fast import capture_shapes, maps_budget_decision
 
         budget_gb = float(os.environ.get("VIDEOP2P_CACHED_MAPS_BUDGET_GB", "6"))
         # the shape check shares cached_fast_edit's OWN capture call, so the
@@ -266,13 +265,14 @@ def main(
             dependent_weight=dep_w,
             dependent_sampler=sampler if dep_w > 0 else None,
         )
-        map_gb = tree_bytes((cached_shapes.cross_maps, cached_shapes.temporal_maps)) / 2**30
         # the budget is per chip: on a frame-sharded mesh the capture trees
         # shard over frames/spatial positions, so each chip holds 1/sp of
         # the global bytes — exactly what makes long-video cached mode fit
         sp_shard = int(mesh.split(",")[1]) if mesh else 1
-        per_chip_gb = map_gb / max(sp_shard, 1)
-        if per_chip_gb > budget_gb:
+        fits, map_gb, per_chip_gb = maps_budget_decision(
+            cached_shapes, sp=sp_shard, budget_gb=budget_gb
+        )
+        if not fits:
             print(
                 f"[p2p] cached-source maps need {per_chip_gb:.1f} GiB/chip "
                 f"(> budget {budget_gb:.1f} GiB) — falling back to the live "

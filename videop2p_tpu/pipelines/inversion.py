@@ -249,6 +249,7 @@ def null_text_optimization(
     key: Optional[jax.Array] = None,
     outer_chunk: Optional[int] = None,
     early_stop: bool = True,
+    return_losses: bool = False,
 ) -> jax.Array:
     """Optimize a per-step unconditional embedding that makes CFG denoising
     replay the recorded inversion trajectory (run_videop2p.py:580-612).
@@ -262,7 +263,13 @@ def null_text_optimization(
     ``trajectory``: (num_steps+1, B, F, h, w, C) from :func:`ddim_inversion`;
     ``cond_embedding`` / ``uncond_embedding``: (B, L, D).
     Returns per-step uncond embeddings (num_steps, B, L, D) to feed
-    ``edit_sample``'s injection seam.
+    ``edit_sample``'s injection seam. With ``return_losses=True`` also
+    returns the FINAL inner-loop reconstruction loss per outer step
+    (num_steps,) — the optimization objective itself
+    (``‖x̂_{t-1} − x_{t-1}‖²``, run_videop2p.py:596), which is the direct
+    reconstruction-parity metric between the early-stopped and fixed-work
+    variants: both minimize the same quantity, so comparable final losses
+    mean comparable reconstruction quality.
 
     In dependent mode every single prediction gets the same AR-noise blend
     the inversion used — ``ε = (1-w)·ε̂ + w·ar_noise`` with a FRESH draw per
@@ -328,7 +335,7 @@ def null_text_optimization(
             return (u, opt_state, loss, j + 1, k)
 
         opt_state = adam.init(uncond)
-        uncond, _, _, _, key = jax.lax.while_loop(
+        uncond, _, final_loss, _, key = jax.lax.while_loop(
             inner_cond, inner_body, (uncond, opt_state, jnp.inf, 0, key)
         )
 
@@ -341,7 +348,7 @@ def null_text_optimization(
         eps_c = blend(eps_cond_raw, k_fc)
         eps = eps_uncond + guidance_scale * (eps_c - eps_uncond)
         latent_cur = scheduler.prev_step(eps, t, latent_cur, num_inference_steps)
-        return (latent_cur, uncond, key, params, cond_embedding), uncond
+        return (latent_cur, uncond, key, params, cond_embedding), (uncond, final_loss)
 
     x_t = trajectory[-1]
     xs = (timesteps, prev_seq, lr_seq, thresh_seq)
@@ -358,10 +365,10 @@ def null_text_optimization(
         return body
 
     if not outer_chunk or outer_chunk >= num_inference_steps:
-        _, uncond_seq = jax.lax.scan(
+        _, (uncond_seq, losses) = jax.lax.scan(
             make_body(params, cond_embedding), (x_t, uncond_embedding, key), xs
         )
-        return uncond_seq
+        return (uncond_seq, losses) if return_losses else uncond_seq
 
     # chunked path: params/cond enter as plain jit inputs (same no-carry rule
     # as above), and the jitted chunk scan is cached on the statics its
@@ -384,9 +391,13 @@ def null_text_optimization(
         chunk_scan = jax.jit(chunk_fn)
         _CHUNK_SCAN_CACHE[cache_key] = chunk_scan
     small = (x_t, uncond_embedding, key)
-    pieces = []
+    pieces, loss_pieces = [], []
     for start in range(0, num_inference_steps, outer_chunk):
         chunk = jax.tree.map(lambda a: a[start : start + outer_chunk], xs)
-        small, seq = chunk_scan(params, cond_embedding, small, chunk)
+        small, (seq, losses) = chunk_scan(params, cond_embedding, small, chunk)
         pieces.append(seq)
-    return jnp.concatenate(pieces, axis=0)
+        loss_pieces.append(losses)
+    uncond_seq = jnp.concatenate(pieces, axis=0)
+    if return_losses:
+        return uncond_seq, jnp.concatenate(loss_pieces, axis=0)
+    return uncond_seq
