@@ -499,6 +499,89 @@ def test_maps_budget_gate_scales_to_long_video(sched, tiny, ctx5):
     assert per4 == pytest.approx(per1 / 4)
 
 
+def test_float8_temporal_maps_keep_source_exact_and_edit_close(sched, tiny, ctx5):
+    """The long-video budget mode stores temporal maps in float8
+    (inversion.py temporal_maps_dtype). Two pinned properties: the source
+    stream's replay stays BIT-exact (it is ε-based — storage precision of
+    the maps cannot touch it), and the edited stream stays close to the
+    full-precision-maps output (the maps only enter via the controller's
+    base-map replacement)."""
+    fn, params, cfg = tiny
+    x0 = jax.random.normal(jax.random.key(60), SHAPE)
+    cond = jax.random.normal(jax.random.key(61), (2, 77, cfg.cross_attention_dim))
+    uncond = jnp.zeros((77, cfg.cross_attention_dim))
+    c, sw = _windows(ctx5, STEPS)
+
+    def run(tm_dtype):
+        traj, cached = jax.jit(
+            lambda p, x: ddim_inversion_captured(
+                fn, p, sched, x, cond[:1], num_inference_steps=STEPS,
+                cross_len=c, self_window=sw, capture_blend=True,
+                blend_res=(4, 4), temporal_maps_dtype=tm_dtype,
+            )
+        )(params, x0)
+        out = jax.jit(
+            lambda p, xt, cc: edit_sample(
+                fn, p, sched, xt, cond, uncond,
+                num_inference_steps=STEPS, ctx=ctx5, source_uses_cfg=False,
+                blend_res=(4, 4), cached_source=cc,
+            )
+        )(params, traj[-1], cached)
+        return cached, out
+
+    cached8, out8 = run(jnp.float8_e4m3fn)
+    _, out16 = run(None)
+    stored = {
+        str(a.dtype)
+        for a in jax.tree.leaves(cached8.temporal_maps)
+    }
+    assert stored == {"float8_e4m3fn"}
+    np.testing.assert_array_equal(np.asarray(out8[0]), np.asarray(x0[0]))
+    # e4m3 keeps ~2 significant digits on [0,1] probabilities; the edit
+    # output moves by far less than the cached-vs-live deltas the mode
+    # already discloses
+    scale = float(np.abs(np.asarray(out16[1], np.float32)).mean())
+    delta = float(np.abs(np.asarray(out8[1], np.float32)
+                         - np.asarray(out16[1], np.float32)).max())
+    assert delta <= 0.15 * max(scale, 1.0), (delta, scale)
+
+
+def test_choose_cached_maps_escalates_to_float8(sched, tiny, ctx5):
+    """The shared CLI/bench decision helper: full-precision first, float8
+    temporal storage when bf16 overflows the per-chip budget, live
+    fallback only when even float8 does."""
+    from videop2p_tpu.pipelines.fast import (
+        capture_shapes,
+        choose_cached_maps,
+        maps_budget_decision,
+    )
+
+    fn, params, cfg = tiny
+    c, sw = _windows(ctx5, STEPS)
+    cond = jax.random.normal(jax.random.key(62), (2, 77, cfg.cross_attention_dim))
+    x = jnp.zeros((1, 24, 8, 8, 4))
+
+    def shapes_for(dt):
+        return capture_shapes(
+            fn, params, sched, x, cond[:1], ctx5,
+            num_inference_steps=STEPS, cross_len=c, self_window=sw,
+            temporal_maps_dtype=dt,
+        )[1]
+
+    _, gb_full, _ = maps_budget_decision(shapes_for(None))
+    _, gb_f8, _ = maps_budget_decision(shapes_for(jnp.float8_e4m3fn))
+    assert gb_f8 < gb_full
+
+    ok, dt, _, _ = choose_cached_maps(shapes_for, budget_gb=gb_full * 1.01)
+    assert ok and dt is None  # roomy budget → full precision
+    ok, dt, _, _ = choose_cached_maps(
+        shapes_for, budget_gb=(gb_f8 + gb_full) / 2
+    )
+    assert ok and dt is not None  # between the two → float8 temporal maps
+    ok, dt, _, _ = choose_cached_maps(shapes_for, budget_gb=gb_f8 * 0.5)
+    assert not ok  # under even the float8 size → live fallback
+
+
 def test_cached_rejects_invalid_combinations(sched, tiny):
     """cached_source is a fast-mode-only seam: official-mode CFG sources,
     stochastic eta, and per-step null embeddings all contradict the captured

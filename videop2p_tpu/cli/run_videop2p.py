@@ -253,30 +253,36 @@ def main(
         # exactly, so nothing else needs capturing
         cross_len, self_window = capture_windows(ctx, NUM_DDIM_STEPS)
 
-        from videop2p_tpu.pipelines.fast import capture_shapes, maps_budget_decision
+        from videop2p_tpu.pipelines.fast import capture_shapes, choose_cached_maps
 
         budget_gb = float(os.environ.get("VIDEOP2P_CACHED_MAPS_BUDGET_GB", "6"))
+
         # the shape check shares cached_fast_edit's OWN capture call, so the
         # budget always sizes exactly what the fused program will materialize
-        _, cached_shapes = capture_shapes(
-            unet_fn, params, sched, latents, cond_src, ctx,
-            num_inference_steps=NUM_DDIM_STEPS,
-            cross_len=cross_len, self_window=self_window,
-            dependent_weight=dep_w,
-            dependent_sampler=sampler if dep_w > 0 else None,
-        )
+        def shapes_for(tm_dtype):
+            return capture_shapes(
+                unet_fn, params, sched, latents, cond_src, ctx,
+                num_inference_steps=NUM_DDIM_STEPS,
+                cross_len=cross_len, self_window=self_window,
+                dependent_weight=dep_w,
+                dependent_sampler=sampler if dep_w > 0 else None,
+                temporal_maps_dtype=tm_dtype,
+            )[1]
+
         # the budget is per chip: on a frame-sharded mesh the capture trees
         # shard over frames/spatial positions, so each chip holds 1/sp of
-        # the global bytes — exactly what makes long-video cached mode fit
+        # the global bytes — exactly what makes long-video cached mode fit;
+        # when bf16 maps overflow, the decision escalates to float8 storage
+        # for the (quadratic-in-frames) temporal tree before giving up
         sp_shard = int(mesh.split(",")[1]) if mesh else 1
-        fits, map_gb, per_chip_gb = maps_budget_decision(
-            cached_shapes, sp=sp_shard, budget_gb=budget_gb
+        fits, tm_dtype, map_gb, per_chip_gb = choose_cached_maps(
+            shapes_for, sp=sp_shard, budget_gb=budget_gb
         )
         if not fits:
             print(
                 f"[p2p] cached-source maps need {per_chip_gb:.1f} GiB/chip "
-                f"(> budget {budget_gb:.1f} GiB) — falling back to the live "
-                "source stream"
+                f"even with float8 temporal maps (> budget {budget_gb:.1f} "
+                "GiB) — falling back to the live source stream"
             )
             use_cached = False
         else:
@@ -284,6 +290,8 @@ def main(
                 f"[p2p] cached-source fast mode: cross window {cross_len} steps, "
                 f"self window {self_window}, maps {map_gb:.2f} GiB global / "
                 f"{per_chip_gb:.2f} GiB per chip"
+                + (", temporal maps stored float8"
+                   if tm_dtype is not None else "")
             )
 
     # consult the persisted products only once the cached-source decision is
@@ -324,6 +332,7 @@ def main(
                     dependent_weight=dep_w,
                     dependent_sampler=sampler if dep_w > 0 else None,
                     key=k,
+                    temporal_maps_dtype=tm_dtype,
                 )
                 vids = decode_video(bundle.vae, vp, edited.astype(dtype), sequential=True)
                 return traj, (vids.astype(jnp.float32) + 1) / 2

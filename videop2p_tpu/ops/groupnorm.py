@@ -102,8 +102,8 @@ def _gn_kernel(x_ref, scale_ref, bias_ref, gmat_ref, o_ref, *,
                              preferred_element_type=jnp.float32)  # (1, C)
     inv_c = lax.dot_general(inv, gmat, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)
-    scale = scale_ref[...].astype(jnp.float32).reshape(1, c)
-    bias = bias_ref[...].astype(jnp.float32).reshape(1, c)
+    scale = scale_ref[...].astype(jnp.float32)  # (1, C)
+    bias = bias_ref[...].astype(jnp.float32)
     eff_scale = inv_c * scale
     eff_bias = bias - mean_c * eff_scale
 
@@ -164,19 +164,21 @@ def _fused_gn(
         jnp.arange(c)[:, None] // (c // num_groups)
         == jnp.arange(num_groups)[None, :]
     ).astype(jnp.float32)
+    # scale/bias ride as (1, C) — rank-1 operands hit Mosaic layout
+    # restrictions that rank-2 lane-major vectors don't
     return pl.pallas_call(
         functools.partial(_gn_kernel, eps=eps, rows=rows, act=act),
         out_shape=jax.ShapeDtypeStruct((n, rows, c), x.dtype),
         grid=(n,),
         in_specs=[
             pl.BlockSpec((1, rows, c), lambda i: (i, 0, 0)),
-            pl.BlockSpec((c,), lambda i: (0,)),
-            pl.BlockSpec((c,), lambda i: (0,)),
+            pl.BlockSpec((1, c), lambda i: (0, 0)),
+            pl.BlockSpec((1, c), lambda i: (0, 0)),
             pl.BlockSpec((c, num_groups), lambda i: (0, 0)),
         ],
         out_specs=pl.BlockSpec((1, rows, c), lambda i: (i, 0, 0)),
         interpret=interpret,
-    )(x, scale, bias, gmat)
+    )(x, scale.reshape(1, c), bias.reshape(1, c), gmat)
 
 
 def _fused_gn_fwd(x, scale, bias, num_groups, eps, act, interpret):

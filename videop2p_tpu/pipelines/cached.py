@@ -154,6 +154,14 @@ class CachedSource(struct.PyTreeNode):
         if self.temporal_maps and hi > lo:
             idx = jnp.clip(step_index - lo, 0, hi - lo - 1)
             temporal = slice_site_tree(self.temporal_maps, idx)
+            # maps may be STORED in a narrow float8 (the long-video budget
+            # mode, inversion.py temporal_maps_dtype) — upcast to the edit's
+            # probability compute dtype at read
+            temporal = jax.tree.map(
+                lambda a: a.astype(jnp.bfloat16)
+                if jnp.dtype(a.dtype).itemsize == 1 else a,
+                temporal,
+            )
         if cross is None and temporal is None:
             return None
         return merge_site_trees(cross, temporal)

@@ -20,7 +20,37 @@ from videop2p_tpu.core.noise import DependentNoiseSampler
 from videop2p_tpu.pipelines.inversion import ddim_inversion_captured
 from videop2p_tpu.pipelines.sampling import UNetFn, edit_sample
 
-__all__ = ["cached_fast_edit", "capture_shapes", "maps_budget_decision"]
+__all__ = [
+    "cached_fast_edit",
+    "capture_shapes",
+    "maps_budget_decision",
+    "choose_cached_maps",
+]
+
+
+def choose_cached_maps(shapes_for, *, sp: int = 1, budget_gb: float = 6.0):
+    """Escalating cached-mode decision shared by the CLI and bench: try
+    full-precision (bf16) capture first; if the per-chip budget refuses,
+    retry with the temporal maps stored in float8 (the quadratic-in-frames
+    tree — 8f: 0.6 GiB → 24f: 5.8 GiB at SD scale — halves; probabilities
+    in [0,1] keep ~2 significant digits in e4m3, and only the edit
+    stream's map replacement reads them, never the exact source replay).
+
+    ``shapes_for(temporal_maps_dtype)`` must return the
+    :func:`capture_shapes` CachedSource shape tree for that storage dtype.
+
+    Returns ``(use_cached, temporal_maps_dtype, map_gb, per_chip_gb)`` —
+    dtype None means full precision.
+    """
+    import jax.numpy as jnp
+
+    for dt in (None, jnp.float8_e4m3fn):
+        fits, map_gb, per_chip_gb = maps_budget_decision(
+            shapes_for(dt), sp=sp, budget_gb=budget_gb
+        )
+        if fits:
+            return True, dt, map_gb, per_chip_gb
+    return False, None, map_gb, per_chip_gb
 
 
 def maps_budget_decision(cached_shapes, *, sp: int = 1,
@@ -57,6 +87,7 @@ def capture_shapes(
     self_window: Tuple[int, int] = (0, 0),
     dependent_weight: float = 0.0,
     dependent_sampler: Optional[DependentNoiseSampler] = None,
+    temporal_maps_dtype=None,
 ):
     """``eval_shape`` of the EXACT capture :func:`cached_fast_edit` will run
     — for HBM budgeting (cli/run_videop2p.py). Sharing the call site means a
@@ -73,6 +104,7 @@ def capture_shapes(
             dependent_weight=dependent_weight,
             dependent_sampler=dependent_sampler,
             key=k,
+            temporal_maps_dtype=temporal_maps_dtype,
         ),
         params, latents, jax.random.key(0),
     )
@@ -95,6 +127,7 @@ def cached_fast_edit(
     dependent_weight: float = 0.0,
     dependent_sampler: Optional[DependentNoiseSampler] = None,
     key: Optional[jax.Array] = None,
+    temporal_maps_dtype=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Capture-inversion of ``latents`` under ``cond_src`` followed by the
     cached-source controlled edit under ``cond_all``/``uncond``. Returns
@@ -109,6 +142,7 @@ def cached_fast_edit(
         dependent_weight=dependent_weight,
         dependent_sampler=dependent_sampler,
         key=key,
+        temporal_maps_dtype=temporal_maps_dtype,
     )
     edited = edit_sample(
         unet_fn, params, scheduler, trajectory[-1], cond_all, uncond,

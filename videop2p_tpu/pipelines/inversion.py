@@ -125,9 +125,22 @@ def ddim_inversion_captured(
     dependent_weight: float = 0.0,
     dependent_sampler: Optional[DependentNoiseSampler] = None,
     key: Optional[jax.Array] = None,
+    temporal_maps_dtype=None,
 ) -> Tuple[jax.Array, CachedSource]:
     """DDIM inversion that also captures everything a cached-source edit
     needs (see :mod:`videop2p_tpu.pipelines.cached` for the design).
+
+    ``temporal_maps_dtype``: optional narrower STORAGE dtype for the
+    captured temporal (attn_temp) probability maps — e.g.
+    ``jnp.float8_e4m3fn``. The temporal tree is the long-video memory
+    cliff: per spatial position it holds an F×F map, so its bytes grow
+    quadratically with frame count (8f: 0.6 GiB → 24f: 5.8 GiB at SD
+    scale) while everything else grows linearly. Probabilities live in
+    [0, 1] where e4m3 keeps ~2 significant digits; the maps are read back
+    upcast to the compute dtype (cached.py ``base_tree_at``), they only
+    feed the EDIT stream's map replacement, and the source-stream replay
+    is ε-based — its bit-exactness guarantee is unaffected
+    (tests/test_cached.py pins both properties).
 
     Same walk as :func:`ddim_inversion`, but split into segments so that the
     full per-head controlled-site probabilities are stacked ONLY for the
@@ -191,7 +204,12 @@ def ddim_inversion_captured(
             if want_cross:
                 ys["cross"] = filter_site_tree(store["attn_base"], "attn2")
             if want_temporal:
-                ys["temporal"] = filter_site_tree(store["attn_base"], "attn_temp")
+                t_tree = filter_site_tree(store["attn_base"], "attn_temp")
+                if temporal_maps_dtype is not None:
+                    t_tree = jax.tree.map(
+                        lambda a: a.astype(temporal_maps_dtype), t_tree
+                    )
+                ys["temporal"] = t_tree
             return (latent, key), ys
 
         return jax.lax.scan(body, (latent, key), ts)
