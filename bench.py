@@ -194,9 +194,11 @@ class Reading(NamedTuple):
     suspect: bool
     source: str  # "wall" | "device_trace"
     x_used: object  # the input of the accepted (or max) attempt
+    samples: tuple = ()  # all valid readings, when samples>1 was requested
 
 
-def measure_with_floor(call, fresh_inputs, floor_s: float, what: str) -> Reading:
+def measure_with_floor(call, fresh_inputs, floor_s: float, what: str,
+                       samples: int = 1) -> Reading:
     """Wall-clock ``call(x)`` and validate it against a physical floor.
 
     The axon tunnel intermittently completes a repeat-shape execution
@@ -211,13 +213,25 @@ def measure_with_floor(call, fresh_inputs, floor_s: float, what: str) -> Reading
     records). ``suspect`` is True only when no source cleared the floor — the
     max wall reading is then reported, paired with its own output and input.
     A NaN floor (unknown-peak device) accepts the first reading.
+
+    ``samples > 1``: instead of accepting the FIRST above-floor reading
+    (which carries whatever residual first-run bias the warm-up missed),
+    keep measuring until ``samples`` valid readings exist (bounded by the
+    fresh inputs supplied) and report the MEDIAN one, with every valid
+    reading recorded in ``Reading.samples`` — the discard-first /
+    report-spread discipline the shard proxy uses, applied to the phases
+    of record (VERDICT r4 weak #7).
     """
     best = None  # (out, dt, x) of the max-dt attempt, kept together
+    valid = []  # (out, dt, x) of every above-floor attempt (samples mode)
     n = len(fresh_inputs)
     for i, x in enumerate(fresh_inputs):
         # the trace machinery is strictly best-effort: any profiler or parser
-        # failure must degrade to the wall reading, never lose the phase
-        trace_this = i == n - 1 and floor_s == floor_s
+        # failure must degrade to the wall reading, never lose the phase;
+        # in samples mode a valid reading already exists by the last
+        # attempt in the healthy case — don't contaminate it with tracer
+        # overhead (the trace is the all-sub-floor forensic path)
+        trace_this = i == n - 1 and floor_s == floor_s and not valid
         tdir = None
         try:
             if trace_this:
@@ -251,7 +265,15 @@ def measure_with_floor(call, fresh_inputs, floor_s: float, what: str) -> Reading
             if best is None or dt > best[1]:
                 best = (out, dt, x)
             if floor_s != floor_s or dt >= floor_s:
-                return Reading(out, dt, False, "wall", x)
+                if samples <= 1:
+                    return Reading(out, dt, False, "wall", x)
+                valid.append((out, dt, x))
+                if len(valid) >= samples or i == n - 1:
+                    valid.sort(key=lambda v: v[1])
+                    o, d, xu = valid[len(valid) // 2]
+                    return Reading(o, d, False, "wall", xu,
+                                   tuple(round(v[1], 3) for v in valid))
+                continue
             print(
                 f"[bench] {what}: {dt:.3f}s is below the physical floor "
                 f"{floor_s:.2f}s — "
@@ -306,6 +328,15 @@ def measure_with_floor(call, fresh_inputs, floor_s: float, what: str) -> Reading
         finally:
             if tdir:
                 shutil.rmtree(tdir, ignore_errors=True)
+    if valid:
+        # samples mode, loop exhausted by a sub-floor LAST attempt: the
+        # already-collected valid readings are still trustworthy — report
+        # their median, not a suspect max-wall (the flake consumed a retry,
+        # it must not poison the phase)
+        valid.sort(key=lambda v: v[1])
+        o, d, xu = valid[len(valid) // 2]
+        return Reading(o, d, False, "wall", xu,
+                       tuple(round(v[1], 3) for v in valid))
     return Reading(best[0], best[1], True, "wall", best[2])
 
 
@@ -512,6 +543,55 @@ def build_fast_edit_working_point(*, num_frames: int = 8, num_steps: int = 50,
     )
 
 
+_GN_PROBE_SCRIPT = """
+import jax, jax.numpy as jnp
+from videop2p_tpu.ops.groupnorm import fused_group_norm
+# every (rows, C) slab class the VMEM gate admits across the bench's model
+# shapes, in BOTH site configurations: the transformer-entry GN
+# (act='none', eps=1e-6 — attention.py) and the resnet GN+SiLU
+# (act='silu', eps=1e-5 — layers.py)
+for rows, c in ((4096, 320), (1024, 640), (256, 1280),
+                (512, 1280), (1024, 1280)):
+    for act, eps in (("none", 1e-6), ("silu", 1e-5)):
+        out = jax.jit(
+            lambda x, ch=c, a=act, e=eps: fused_group_norm(
+                x, jnp.ones((ch,)), jnp.zeros((ch,)),
+                num_groups=32, act=a, eps=e,
+            )
+        )(jnp.ones((1, rows, c), jnp.bfloat16))
+        # value fetch: a hung dispatch must hang HERE, inside the timeout
+        float(jnp.asarray(out).ravel()[0].astype(jnp.float32))
+print("GN_PROBE_OK")
+"""
+
+
+def _fused_gn_probe_ok(timeout_s: float = 420.0) -> bool:
+    """Compile+run the fused GroupNorm kernel at every slab class the bench
+    will embed it in — in a SUBPROCESS with a timeout: a Mosaic regression
+    can HANG the chip, not just raise, and a hang in the parent would cost
+    the round its driver artifact (the r4 failure class). Any failure mode
+    demotes the whole bench to the XLA two-pass path."""
+    try:
+        env = dict(os.environ)
+        repo = os.path.dirname(os.path.abspath(__file__))
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.run(
+            [sys.executable, "-c", _GN_PROBE_SCRIPT],
+            capture_output=True, text=True, timeout=timeout_s, env=env,
+        )
+    except (subprocess.TimeoutExpired, OSError) as e:
+        print(f"[bench] fused-GroupNorm probe timed out/failed to launch "
+              f"({type(e).__name__}) — group_norm='xla'",
+              file=sys.stderr, flush=True)
+        return False
+    if proc.returncode != 0 or "GN_PROBE_OK" not in proc.stdout:
+        print(f"[bench] fused-GroupNorm probe failed (rc={proc.returncode}): "
+              f"{proc.stderr[-300:]} — group_norm='xla'",
+              file=sys.stderr, flush=True)
+        return False
+    return True
+
+
 def main() -> None:
     if not wait_for_backend():
         emit_backend_unavailable()
@@ -535,25 +615,8 @@ def main() -> None:
               "(valid: auto/xla/interpret) — using 'auto'",
               file=sys.stderr, flush=True)
         gn_impl = "auto"
-    if gn_impl == "auto":
-        try:
-            from videop2p_tpu.ops.groupnorm import fused_group_norm
-
-            for rows, c in ((4096, 320), (1024, 640), (256, 1280),
-                            (512, 1280), (1024, 1280)):
-                probe_x = jnp.ones((1, rows, c), jnp.bfloat16)
-                hard_block(jax.jit(
-                    lambda x, r=rows, ch=c: fused_group_norm(
-                        x, jnp.ones((ch,)), jnp.zeros((ch,)),
-                        num_groups=32, act="silu",
-                    )
-                )(probe_x))
-            del probe_x
-        except Exception as e:  # noqa: BLE001
-            print(f"[bench] fused-GroupNorm probe failed "
-                  f"({type(e).__name__}: {str(e)[:200]}) — group_norm='xla'",
-                  file=sys.stderr, flush=True)
-            gn_impl = "xla"
+    if gn_impl == "auto" and not _fused_gn_probe_ok():
+        gn_impl = "xla"
     wp = build_fast_edit_working_point(
         num_frames=F, num_steps=STEPS, cached=True, group_norm=gn_impl
     )
@@ -611,10 +674,15 @@ def main() -> None:
     out, edit_s = r_edit.out, r_edit.seconds
     r_e2e = measure_with_floor(
         lambda x: wp.e2e_cached(params, x),
+        # 5 fresh inputs for 3 samples: sub-floor tunnel flakes consume
+        # retries without starving the median
         [jax.random.normal(jax.random.fold_in(base, k), x0.shape, x0.dtype)
-         for k in (11, 12, 13)],
+         for k in (11, 12, 13, 14, 15)],
         (inv_flops + edit_flops) / peak,
         "fused e2e",
+        # the HEADLINE number: median of three valid runs with the spread
+        # recorded, not first-accepted (VERDICT r4 weak #7 discipline)
+        samples=3,
     )
     elapsed = r_e2e.seconds
 
@@ -646,6 +714,9 @@ def main() -> None:
     rec.record("edit_s", round(edit_s, 3), reading=r_edit)
     # the headline: one fused dispatch (phase sum adds one tunnel round trip)
     rec.record("fast_edit_e2e_fused_s", round(elapsed, 3), reading=r_e2e)
+    if r_e2e.samples:
+        rec.record("fast_edit_e2e_fused_samples", list(r_e2e.samples),
+                   derived=(r_e2e,))
     rec.record("inversion_step_ms", round(inv_s / STEPS * 1e3, 1), derived=(r_inv,))
     rec.record("edit_step_ms", round(edit_s / STEPS * 1e3, 1), derived=(r_edit,))
     rec.record("frames_per_sec", round(F / elapsed, 3), derived=(r_e2e,))
@@ -838,31 +909,28 @@ def main() -> None:
                                                group_norm=gn_impl)
             hard_block(ws.edit(ws.params, ws.invert(ws.params, ws.x_warm)[-1]))
             # the proxy phases are short (~2-4 s) and carry tunnel timing
-            # noise that wobbled the projection ±15 % between rounds — take
-            # three samples per phase and use the median (VERDICT r3 item 6)
-            sinv_rs, sedit_rs = [], []
-            for rep in range(3):
-                r_sinv = measure_with_floor(
-                    lambda x: ws.invert(ws.params, x),
-                    [ws.x0 + 1e-3 * rep, ws.x0 - 1e-3 * (rep + 1)],
-                    FLOPS_PER_FRAME_FWD * F_SHARD * STEPS / peak,
-                    f"shard inversion #{rep}",
-                )
-                r_sedit = measure_with_floor(
-                    lambda xt: ws.edit(ws.params, xt),
-                    [r_sinv.out[-1], r_sinv.out[-1] + 0.001],
-                    FLOPS_PER_FRAME_FWD * 3 * F_SHARD * STEPS / peak,
-                    f"shard edit #{rep}",
-                )
-                sinv_rs.append(r_sinv)
-                sedit_rs.append(r_sedit)
-            med = lambda rs: sorted(rs, key=lambda r: r.seconds)[len(rs) // 2]  # noqa: E731
-            r_sinv, r_sedit = med(sinv_rs), med(sedit_rs)
+            # noise that wobbled the projection ±15 % between rounds —
+            # median of three valid samples per phase (VERDICT r3 item 6),
+            # via measure_with_floor's samples mode with retry headroom
+            r_sinv = measure_with_floor(
+                lambda x: ws.invert(ws.params, x),
+                [ws.x0 + 1e-3 * k for k in range(1, 6)],
+                FLOPS_PER_FRAME_FWD * F_SHARD * STEPS / peak,
+                "shard inversion",
+                samples=3,
+            )
+            r_sedit = measure_with_floor(
+                lambda xt: ws.edit(ws.params, xt),
+                [r_sinv.out[-1] + 1e-3 * k for k in range(5)],
+                FLOPS_PER_FRAME_FWD * 3 * F_SHARD * STEPS / peak,
+                "shard edit",
+                samples=3,
+            )
             rec.record("shard2_inversion_s", round(r_sinv.seconds, 3), reading=r_sinv)
             rec.record("shard2_edit_s", round(r_sedit.seconds, 3), reading=r_sedit)
             rec.record("shard2_samples", {
-                "inversion_s": [round(r.seconds, 3) for r in sinv_rs],
-                "edit_s": [round(r.seconds, 3) for r in sedit_rs],
+                "inversion_s": list(r_sinv.samples),
+                "edit_s": list(r_sedit.samples),
             })
             try:
                 _project = _tools_import("projection").project

@@ -147,6 +147,30 @@ def test_above_floor_trace_span_is_trusted(bench, monkeypatch):
     assert not r.suspect
 
 
+def test_samples_mode_reports_median_and_spread(bench):
+    """samples=3: the reading of record is the MEDIAN of three valid runs,
+    with every valid reading recorded (discard-first/report-spread
+    discipline on the headline phase)."""
+    r = bench.measure_with_floor(
+        lambda x: bench.jnp.float32(x), [1.0, 2.0, 3.0],
+        floor_s=0.0, what="t", samples=3,
+    )
+    assert len(r.samples) == 3
+    assert not r.suspect
+    assert round(r.seconds, 3) == sorted(r.samples)[1]
+
+
+def test_samples_mode_single_valid_still_returns(bench):
+    """Fewer valid readings than requested samples: return what exists
+    (bounded by the supplied fresh inputs) rather than failing."""
+    r = bench.measure_with_floor(
+        lambda x: bench.jnp.float32(x), [1.0],
+        floor_s=0.0, what="t", samples=3,
+    )
+    assert len(r.samples) == 1
+    assert round(r.seconds, 3) == r.samples[0]
+
+
 # ---------------------------------------------------- __graft_entry__.py --
 
 
