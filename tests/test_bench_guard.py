@@ -171,6 +171,44 @@ def test_samples_mode_single_valid_still_returns(bench):
     assert round(r.seconds, 3) == r.samples[0]
 
 
+def test_details_recorder_merges_and_flags_stale(bench, tmp_path):
+    """bench_details.json survives partial runs: keys from a previous run
+    are inherited but flagged stale until re-measured; re-recording
+    freshens them; suspect propagation follows the Reading."""
+    path = str(tmp_path / "details.json")
+    rec1 = bench.DetailsRecorder(path, {"device": "t"}, [])
+    r_ok = bench.Reading(None, 1.0, False, "wall", None)
+    r_bad = bench.Reading(None, 2.0, True, "wall", None)
+    rec1.record("a_s", 1.0, reading=r_ok)
+    rec1.record("b_s", 2.0, reading=r_bad)
+    saved = json.load(open(path))["breakdown"]
+    assert saved["a_s"] == 1.0
+    assert saved["suspect_measurements"] == ["b_s"]
+    assert "stale_from_previous_run" not in saved
+
+    # a later (partial) run inherits both, flags them stale, then
+    # re-measures one — which must clear BOTH its stale and suspect marks
+    rec2 = bench.DetailsRecorder(path, {"device": "t"}, [])
+    assert set(rec2.stale) >= {"a_s", "b_s"}
+    rec2.record("b_s", 2.5, reading=r_ok)
+    saved = json.load(open(path))["breakdown"]
+    assert saved["b_s"] == 2.5
+    assert "b_s" not in saved.get("suspect_measurements", [])
+    assert "b_s" not in saved.get("stale_from_previous_run", [])
+    assert "a_s" in saved["stale_from_previous_run"]
+
+    # derived values inherit suspicion from their constituents
+    rec2.record("c_s", 3.0, derived=(r_bad,))
+    saved = json.load(open(path))["breakdown"]
+    assert "c_s" in saved["suspect_measurements"]
+
+    # drop removes inherited keys entirely (e.g. a renamed metric)
+    rec2.drop("a_s")
+    saved = json.load(open(path))["breakdown"]
+    assert "a_s" not in saved
+    assert "a_s" not in saved.get("stale_from_previous_run", [])
+
+
 # ---------------------------------------------------- __graft_entry__.py --
 
 
