@@ -330,6 +330,23 @@ def test_sharded_cached_source_edit_matches_unsharded(mesh8):
     # the replay exactness survives sharding
     np.testing.assert_array_equal(np.asarray(out2[0]), np.asarray(s_x0[0]))
 
+    # the long-video budget mode's float8 temporal storage must partition
+    # identically (GSPMD treats the narrow dtype like any other): sharded
+    # f8 matches unsharded f8, and replay exactness is dtype-independent
+    def invcap8(p, x):
+        return ddim_inversion_captured(
+            fn, p, sched, x, cond[:1], num_inference_steps=STEPS,
+            cross_len=c, self_window=sw, capture_blend=True, blend_res=(4, 4),
+            temporal_maps_dtype=jnp.float8_e4m3fn,
+        )
+
+    traj18, cc18 = jax.jit(invcap8)(params, x0)
+    out18 = jax.jit(edit)(params, traj18[-1], cc18)
+    traj28, cc28 = jax.jit(invcap8)(s_params, s_x0)
+    out28 = jax.jit(edit)(s_params, traj28[-1], cc28)
+    np.testing.assert_allclose(np.asarray(out18), np.asarray(out28), atol=2e-3)
+    np.testing.assert_array_equal(np.asarray(out28[0]), np.asarray(s_x0[0]))
+
 
 def test_hybrid_mesh_single_slice_and_distributed_noop():
     """make_hybrid_mesh on one slice equals the plain reshape;
