@@ -36,6 +36,11 @@ import jax.numpy as jnp  # noqa: E402
 
 def _family(op_name: str) -> str:
     n = op_name.lower()
+    # the GN kernel is ALSO a Pallas custom call — it carries an explicit
+    # name= (ops/groupnorm.py pallas_call) precisely so this A/B can split
+    # it from the attention kernel's custom calls
+    if "fused_group_norm" in n:
+        return "groupnorm (kernel)"
     if "custom-call" in n or "attn" in n and "fusion" not in n:
         return "attn (custom-call)"
     if n.startswith("convert") or "convert" in n.split(".")[0]:

@@ -168,6 +168,10 @@ def _fused_gn(
     # restrictions that rank-2 lane-major vectors don't
     return pl.pallas_call(
         functools.partial(_gn_kernel, eps=eps, rows=rows, act=act),
+        # explicit name: trace events otherwise carry only the flax scope
+        # (norm1/norm2/…), making the kernel indistinguishable from the
+        # XLA-path ops in an A/B profile (tools/bench_groupnorm.py)
+        name="fused_group_norm",
         out_shape=jax.ShapeDtypeStruct((n, rows, c), x.dtype),
         grid=(n,),
         in_specs=[
