@@ -98,6 +98,7 @@ def test_unfittable_shape_raises():
                          interpret=True)
 
 
+@pytest.mark.slow  # ~17 s: two full UNet compiles (interpret-GN vs XLA-GN)
 def test_unet_forward_same_with_fused_gn():
     """The whole UNet must produce the same output through the fused-GN
     path (kernel in interpret mode) as through the XLA two-pass path —
@@ -142,3 +143,17 @@ def test_gn_gradients_flow_through_fused_path():
     g_r = jax.grad(loss_ref, argnums=(0, 1, 2))(x, scale, bias)
     for a, b_ in zip(g_f, g_r):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=1e-4)
+
+
+def test_tpu_groupnorm_rejects_unknown_impl():
+    """A typo'd impl (e.g. 'pallas') must raise, not silently select the
+    XLA fallback and change the performance path (ADVICE r5 item 3)."""
+    from videop2p_tpu.models.layers import TpuGroupNorm
+
+    x = jnp.ones((1, 8, 32))
+    good = TpuGroupNorm(num_groups=4, impl="xla")
+    params = good.init(jax.random.key(0), x)
+    for impl in ("auto", "xla", "interpret"):
+        TpuGroupNorm(num_groups=4, impl=impl).apply(params, x)
+    with pytest.raises(ValueError, match="impl"):
+        TpuGroupNorm(num_groups=4, impl="pallas").apply(params, x)

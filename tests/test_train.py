@@ -95,6 +95,7 @@ def test_train_step_descends_and_freezes(tiny):
     assert changed > 0 and unchanged > 0
 
 
+@pytest.mark.slow  # ~15 s: compiles the scanned AND the sequential program
 def test_train_steps_scan_matches_sequential(tiny):
     """train_steps (one lax.scan over K steps — the CLI's dispatch-batched
     loop) must reproduce K sequential train_step calls with per-step keys
@@ -213,6 +214,7 @@ def test_checkpoint_roundtrip(tmp_path, tiny):
     assert latest_checkpoint(str(tmp_path / "nope")) is None
 
 
+@pytest.mark.slow  # ~19 s: two full UNet grad compiles (policy vs none)
 def test_remat_policy_threads_through_blocks():
     """remat_policy selects a jax.checkpoint policy for the per-block remat;
     gradients must flow and match the no-policy remat numerically."""

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 __all__ = [
     "load_config",
     "add_dependent_args",
+    "add_null_text_args",
     "dependent_suffix",
     "resolve_pipeline_dir",
     "build_models",
@@ -120,6 +121,28 @@ def add_dependent_args(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--num_frames", default=60, type=int)
     parser.add_argument("--eta", default=0.0, type=float)
     parser.add_argument("--dependent_weights", default=0.0, type=float)
+
+
+def add_null_text_args(parser: argparse.ArgumentParser) -> None:
+    """Official-mode null-text optimization knobs (pipelines/inversion.py)."""
+    # defaults are None so a config-file value wins when the flag is unset
+    # (the mixed_precision precedence pattern); the effective defaults live
+    # on run_videop2p.main (fp32, chunk 0 = fused single dispatch)
+    parser.add_argument(
+        "--null_text_precision", type=str, default=None,
+        choices=["fp32", "mixed"],
+        help="null-text inner-loop precision: fp32 (default — reference "
+             "behavior) or mixed — bf16 UNet forwards with fp32 "
+             "scheduler/Adam/loss islands (~3-4x faster inner steps on "
+             "TPU, reconstruction pinned within the fixed-work PSNR band)",
+    )
+    parser.add_argument(
+        "--null_text_chunk", type=int, default=None,
+        help="0 (default): run null-text optimization as ONE jitted device "
+             "program with the trajectory buffer donated; N>0: split the "
+             "outer scan into N-step host-dispatched chunks (the TPU "
+             "execution-watchdog fallback for multi-minute fp32 programs)",
+    )
 
 
 def dependent_suffix(

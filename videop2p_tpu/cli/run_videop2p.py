@@ -24,6 +24,7 @@ import numpy as np
 
 from videop2p_tpu.cli.common import (
     add_dependent_args,
+    add_null_text_args,
     build_models,
     encode_prompts,
     load_config,
@@ -40,6 +41,7 @@ from videop2p_tpu.pipelines import (
     edit_sample,
     make_unet_fn,
     null_text_optimization,
+    null_text_optimization_fused,
 )
 from videop2p_tpu.utils.profiling import phase_timer
 from videop2p_tpu.utils.video_io import save_video_gif
@@ -84,6 +86,13 @@ def main(
     tiny: bool = False,
     width: int = 512,
     num_inner_steps: int = 10,
+    # null-text inner-loop precision: "mixed" runs the optimization's UNet
+    # forwards in bf16 (a bf16-compute clone of the UNet over the same
+    # params) with fp32 scheduler/Adam/loss islands (pipelines/inversion.py)
+    null_text_precision: str = "fp32",
+    # 0 = the fused single-dispatch donated-trajectory program;
+    # N>0 = N-step host-dispatched chunks (execution-watchdog fallback)
+    null_text_chunk: int = 0,
     seed: int = 0,
     # cached-source fast mode (pipelines/cached.py): drop the source stream
     # from the edit batch and replay it exactly from the inversion trajectory;
@@ -297,10 +306,15 @@ def main(
     # consult the persisted products only once the cached-source decision is
     # FINAL (incl. the maps-budget fallback): a budget-forced live run is
     # live on every invocation, so reuse keeps its output-identity guarantee
+    # the persisted null embeddings are precision-variant products: a mixed
+    # run must never silently reuse fp32 embeddings (or vice versa)
+    null_tag = f"_i{num_inner_steps}" + (
+        "_mixed" if null_text_precision == "mixed" else ""
+    )
     reused = (
         load_inversion(
             output_folder, inv_key, want_null=not fast,
-            null_tag=f"_i{num_inner_steps}",
+            null_tag=null_tag,
         )
         if reuse_inversion and not use_cached
         else None
@@ -390,25 +404,52 @@ def main(
         # the CFG edit (a 16 GB chip OOMs with all three resident)
         jax.clear_caches()
         key, nk = jax.random.split(key)
-        with phase_timer("null_text_optimization"):
-            null_embeddings = null_text_optimization(
-                unet_fn, params, sched, traj, cond_src, uncond[None],
-                num_inference_steps=NUM_DDIM_STEPS,
-                guidance_scale=GUIDANCE_SCALE,
-                num_inner_steps=num_inner_steps,
-                dependent_weight=dep_w,
-                dependent_sampler=sampler if dep_w > 0 else None,
-                key=nk,
-                # keep each device call short of the execution watchdog
-                outer_chunk=10,
-            )
+        # mixed precision: the inner loop's forwards/backward run on a
+        # bf16-compute clone of the UNet over the SAME params; the fp32
+        # islands (scheduler coefficients, Adam state, loss accumulation)
+        # are the library's contract (pipelines/inversion.py)
+        null_fn = unet_fn
+        if null_text_precision == "mixed" and dtype != jnp.bfloat16:
+            null_fn = make_unet_fn(bundle.unet.clone(dtype=jnp.bfloat16))
+        null_stats = None
+        null_kwargs = dict(
+            num_inference_steps=NUM_DDIM_STEPS,
+            guidance_scale=GUIDANCE_SCALE,
+            num_inner_steps=num_inner_steps,
+            null_text_precision=null_text_precision,
+            dependent_weight=dep_w,
+            dependent_sampler=sampler if dep_w > 0 else None,
+            key=nk,
+        )
+        with phase_timer("null_text_optimization",
+                         count=NUM_DDIM_STEPS * num_inner_steps,
+                         unit="inner-step"):
+            if null_text_chunk > 0:
+                # watchdog fallback: short host-dispatched chunks
+                null_embeddings = null_text_optimization(
+                    null_fn, params, sched, traj, cond_src, uncond[None],
+                    outer_chunk=null_text_chunk, **null_kwargs,
+                )
+            else:
+                # ONE jitted program, trajectory buffer donated (x_t was
+                # extracted and the trajectory persisted above — nothing
+                # reads it after this point)
+                null_embeddings, null_stats = null_text_optimization_fused(
+                    null_fn, params, sched, traj, cond_src, uncond[None],
+                    donate=True, return_stats=True, **null_kwargs,
+                )
             null_embeddings = jax.block_until_ready(null_embeddings)
+        if null_stats is not None:
+            inner_total = int(np.asarray(null_stats["inner_steps"]).sum())
+            print(f"[p2p] null-text ({null_text_precision}): {inner_total} "
+                  f"inner Adam steps across {NUM_DDIM_STEPS} outer steps, "
+                  f"final loss {float(np.asarray(null_stats['final_loss'])[-1]):.3e}")
         if reuse_inversion:
             # trajectory.npy was written after inversion — only the null
             # embeddings are new here
             save_inversion(
                 output_folder, inv_key, None,
-                np.asarray(null_embeddings), null_tag=f"_i{num_inner_steps}",
+                np.asarray(null_embeddings), null_tag=null_tag,
             )
         jax.clear_caches()
 
@@ -481,6 +522,7 @@ if __name__ == "__main__":
                              "reference's Stage-2 behavior; bf16 runs the "
                              "MXU at full rate — ~3.5x faster end-to-end)")
     add_dependent_args(parser)
+    add_null_text_args(parser)
     args = parser.parse_args()
     # multi-host: join the process group before any device use (no-op on a
     # single host; see parallel/distributed.py)
@@ -492,6 +534,10 @@ if __name__ == "__main__":
     args.multi = args.multi or bool(cfg.pop("multi", False))
     if args.mixed_precision is not None:
         cfg["mixed_precision"] = args.mixed_precision
+    if args.null_text_precision is not None:
+        cfg["null_text_precision"] = args.null_text_precision
+    if args.null_text_chunk is not None:
+        cfg["null_text_chunk"] = args.null_text_chunk
     args.mesh = args.mesh or cfg.pop("mesh", None)
     main(
         **cfg,

@@ -11,7 +11,12 @@ TPU-native re-design of the reference's ``DDIMScheduler_dependent``
     (drawn i.i.d. or from :class:`~videop2p_tpu.core.noise.DependentNoiseSampler`)
     so randomness stays key-threaded and the step stays pure;
   * closed-form inversion steps (``next_step`` / ``prev_step``, mirroring
-    /root/reference/run_videop2p.py:445-463) live on the scheduler itself.
+    /root/reference/run_videop2p.py:445-463) live on the scheduler itself;
+  * every step is an fp32 island: ``model_output``/``sample`` are cast to
+    float32 at entry and the αᾱ-coefficient math runs in float32 even when
+    the surrounding trace is bf16 (the mixed-precision null-text program,
+    pipelines/inversion.py) — trajectory fidelity must not depend on the
+    caller's compute dtype. Step outputs are therefore always float32.
 """
 
 from __future__ import annotations
@@ -24,6 +29,12 @@ import numpy as np
 from flax import struct
 
 __all__ = ["DDIMScheduler", "make_beta_schedule"]
+
+
+def _f32(*arrays: jax.Array) -> Tuple[jax.Array, ...]:
+    """The fp32-island entry cast: scheduler math stays float32 under a
+    bf16 trace (no-op on float32 inputs)."""
+    return tuple(jnp.asarray(a).astype(jnp.float32) for a in arrays)
 
 
 def make_beta_schedule(
@@ -162,7 +173,8 @@ class DDIMScheduler(struct.PyTreeNode):
         self, model_output: jax.Array, timestep: jax.Array, sample: jax.Array
     ) -> Tuple[jax.Array, jax.Array]:
         """(pred_x0, pred_eps) under the configured prediction type
-        (dependent_ddim.py:278-290)."""
+        (dependent_ddim.py:278-290). Computed in float32 (fp32 island)."""
+        model_output, sample = _f32(model_output, sample)
         alpha_prod_t = self._alpha_prod(timestep)
         beta_prod_t = 1.0 - alpha_prod_t
         a, b = jnp.sqrt(alpha_prod_t), jnp.sqrt(beta_prod_t)
@@ -207,8 +219,11 @@ class DDIMScheduler(struct.PyTreeNode):
         Returns ``(prev_sample, pred_original_sample)``. When ``eta > 0`` the
         caller must supply ``variance_noise`` (i.i.d. normal or a draw from the
         dependent sampler — the reference's ``dependent=True`` path,
-        dependent_ddim.py:320-334).
+        dependent_ddim.py:320-334). Runs as an fp32 island: inputs are cast
+        to float32 and the returned samples are float32 regardless of the
+        caller's trace dtype.
         """
+        model_output, sample = _f32(model_output, sample)
         prev_timestep = timestep - self.num_train_timesteps // num_inference_steps
 
         alpha_prod_t = self._alpha_prod(timestep)
@@ -248,7 +263,9 @@ class DDIMScheduler(struct.PyTreeNode):
         num_inference_steps: int,
     ) -> jax.Array:
         """Deterministic (η=0, no clipping) x_t → x_{t-Δ}; the form used inside
-        null-text optimization (run_videop2p.py:445-453)."""
+        null-text optimization (run_videop2p.py:445-453). An fp32 island —
+        usable from a bf16 trace without losing trajectory fidelity."""
+        model_output, sample = _f32(model_output, sample)
         prev_timestep = timestep - self.num_train_timesteps // num_inference_steps
         alpha_prod_t = self._alpha_prod(timestep)
         alpha_prod_t_prev = self._alpha_prod(prev_timestep)
@@ -264,7 +281,9 @@ class DDIMScheduler(struct.PyTreeNode):
         sample: jax.Array,
         num_inference_steps: int,
     ) -> jax.Array:
-        """Forward DDIM (inversion) x_{t-Δ} → x_t (run_videop2p.py:455-463)."""
+        """Forward DDIM (inversion) x_{t-Δ} → x_t (run_videop2p.py:455-463).
+        An fp32 island, like :meth:`prev_step`."""
+        model_output, sample = _f32(model_output, sample)
         next_timestep = timestep
         cur_timestep = jnp.minimum(
             next_timestep - self.num_train_timesteps // num_inference_steps,

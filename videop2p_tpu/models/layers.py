@@ -64,6 +64,13 @@ class TpuGroupNorm(nn.Module):
             group_norm_reference,
         )
 
+        if self.impl not in ("auto", "xla", "interpret"):
+            # a typo (e.g. 'pallas') must not silently select the XLA
+            # fallback and change the performance path without a trace
+            raise ValueError(
+                f"TpuGroupNorm impl {self.impl!r} not in "
+                "{'auto', 'xla', 'interpret'}"
+            )
         c = x.shape[-1]
         scale = self.param("scale", nn.initializers.ones, (c,), jnp.float32)
         bias = self.param("bias", nn.initializers.zeros, (c,), jnp.float32)

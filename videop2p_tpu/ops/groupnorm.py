@@ -14,12 +14,18 @@ Reference semantics (torch ``nn.GroupNorm`` used all over
 per-sample, per-group mean/variance over (rows × channels-in-group),
 biased variance, f32 statistics regardless of activation dtype.
 
-The kernel covers the sites whose slab fits the ~16 MB/core VMEM with
-pipelining headroom (``max_slab_bytes`` gate):
+The kernel covers the sites whose slab fits the 3 MiB
+``_DEFAULT_MAX_SLAB_BYTES`` gate (well inside the ~16 MB/core VMEM, with
+pipelining headroom):
 
 * every per-frame transformer-entry GN (frames folded into batch —
   attention.py:361-368): 64²×320 = 2.6 MB … 16²×1280 = 0.65 MB;
-* the frame-pooled resnet GNs at 8² (1.3 MB) and 16² (5.2 MB borderline).
+* the 8-frame frame-pooled resnet GN at 8² (1.3 MB).
+
+Above the gate the XLA path runs: the frame-pooled 16² slab (5.2 MB) and
+the 24-frame pooled 8² slab (~3.9 MB) exceed 3 MiB and always take
+two-pass XLA — raise ``max_slab_bytes`` deliberately if a deployment wants
+to trade VMEM pressure for fusing them.
 
 The big frame-pooled resnet slabs (64²: 21–63 MB, 32²: 10–31 MB) CANNOT be
 single-pass on this hardware: statistics need the full slab before the

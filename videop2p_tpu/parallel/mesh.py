@@ -91,7 +91,19 @@ def make_sharded_frame_attention_fn(mesh: Mesh, impl: str = "auto"):
     """
     from videop2p_tpu.ops import dense_frame_attention, make_frame_attention_fn
 
-    inner = make_frame_attention_fn(impl) or dense_frame_attention
+    resolved = make_frame_attention_fn(impl)
+    if resolved is None and not hasattr(jax, "shard_map"):
+        # dense-einsum path on a legacy-shard_map jax (no ``jax.shard_map``,
+        # only ``jax.experimental.shard_map``): GSPMD partitions the plain
+        # einsum natively — the wrapper is only REQUIRED for Pallas custom
+        # calls — and the legacy shard_map embedded inside the scanned edit
+        # program MISCOMPILES: on jax 0.4.37 the cached edit's passthrough
+        # source stream came back corrupted (max err 4.15 on a pure copy;
+        # __graft_entry__'s dryrun asserts that stream bit-exact). The
+        # standalone kernel is fine — only the scan-embedded program breaks,
+        # so the bypass is gated on the jax API generation, not the backend.
+        return dense_frame_attention
+    inner = resolved or dense_frame_attention
 
     def fn(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
         # q (B, F, H, N, D); k/v (B, H, N, D) — frame-0 KV has no frame axis,
@@ -109,9 +121,11 @@ def make_sharded_frame_attention_fn(mesh: Mesh, impl: str = "auto"):
             )
         qspec = P(ax_d, AXIS_FRAMES, ax_t, None, None)
         kvspec = P(ax_d, ax_t, None, None)
-        return jax.shard_map(
+        from videop2p_tpu.parallel.ring import shard_map_compat
+
+        return shard_map_compat(
             inner, mesh=mesh, in_specs=(qspec, kvspec, kvspec),
-            out_specs=qspec, check_vma=False,
+            out_specs=qspec,
         )(q, k, v)
 
     return fn

@@ -24,7 +24,31 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-__all__ = ["ring_attention", "ring_attention_sharded", "make_ring_temporal_fn"]
+__all__ = [
+    "ring_attention",
+    "ring_attention_sharded",
+    "make_ring_temporal_fn",
+    "shard_map_compat",
+]
+
+
+def shard_map_compat(fn, *, mesh, in_specs, out_specs):
+    """``jax.shard_map`` across the API rename: new jax spells it
+    ``jax.shard_map(..., check_vma=...)``, older releases only have
+    ``jax.experimental.shard_map.shard_map(..., check_rep=...)``.
+    Replication checking stays off in both spellings (the ring kernel's
+    collectives confuse it)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=False,
+    )
 
 
 def ring_attention(
@@ -91,8 +115,8 @@ def ring_attention_sharded(
     spec = P(*spec_parts)
 
     fn = functools.partial(ring_attention, axis_name=axis_name)
-    return jax.shard_map(
-        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
+    return shard_map_compat(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
     )(q, k, v)
 
 

@@ -5,9 +5,10 @@ from videop2p_tpu.pipelines.inversion import (
     ddim_inversion,
     ddim_inversion_captured,
     null_text_optimization,
+    null_text_optimization_fused,
 )
 from videop2p_tpu.pipelines.fast import cached_fast_edit
-from videop2p_tpu.pipelines.sampling import edit_sample, make_unet_fn
+from videop2p_tpu.pipelines.sampling import edit_sample, make_unet_fn, official_edit
 from videop2p_tpu.pipelines.stores import blend_maps_from_store, flatten_store
 
 __all__ = [
@@ -16,8 +17,10 @@ __all__ = [
     "ddim_inversion",
     "ddim_inversion_captured",
     "null_text_optimization",
+    "null_text_optimization_fused",
     "edit_sample",
     "make_unet_fn",
+    "official_edit",
     "blend_maps_from_store",
     "flatten_store",
 ]

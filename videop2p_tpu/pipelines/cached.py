@@ -138,6 +138,21 @@ class CachedSource(struct.PyTreeNode):
     cross_len: int = struct.field(pytree_node=False, default=0)
     self_window: Tuple[int, int] = struct.field(pytree_node=False, default=(0, 0))
 
+    def _capture_compute_dtype(self):
+        """The dtype the capture's full-precision maps carry — the upcast
+        target for float8-stored temporal maps. Sibling cross maps first
+        (same capture forward, same probability compute dtype), then the
+        blend sequence; float32 when every wide sibling was elided (a
+        temporal-only capture declares no other precision)."""
+        for tree in (self.cross_maps, self.blend_seq):
+            for leaf in jax.tree.leaves(tree):
+                if (
+                    hasattr(leaf, "dtype")
+                    and jnp.dtype(leaf.dtype).itemsize > 1
+                ):
+                    return leaf.dtype
+        return jnp.float32
+
     def base_tree_at(self, step_index: jax.Array) -> Optional[Dict[str, Any]]:
         """Per-step base-map tree for :class:`AttnControl.cached_base`.
 
@@ -155,10 +170,14 @@ class CachedSource(struct.PyTreeNode):
             idx = jnp.clip(step_index - lo, 0, hi - lo - 1)
             temporal = slice_site_tree(self.temporal_maps, idx)
             # maps may be STORED in a narrow float8 (the long-video budget
-            # mode, inversion.py temporal_maps_dtype) — upcast to the edit's
-            # probability compute dtype at read
+            # mode, inversion.py temporal_maps_dtype) — upcast at read to
+            # the dtype the sibling captured maps carry (the capture's
+            # probability compute dtype), NOT a hardcoded bf16: in an fp32
+            # run a bf16 upcast would silently narrow the replaced base
+            # maps while the cross maps stay fp32
+            target = self._capture_compute_dtype()
             temporal = jax.tree.map(
-                lambda a: a.astype(jnp.bfloat16)
+                lambda a: a.astype(target)
                 if jnp.dtype(a.dtype).itemsize == 1 else a,
                 temporal,
             )

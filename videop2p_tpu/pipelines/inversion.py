@@ -14,6 +14,16 @@ TPU-native re-design of the reference ``NullInversion``
     ``1e-2·(1−i/100)``, ≤``num_inner_steps`` iterations and early stop at
     ``loss < ε + i·2e-5`` — the early stop becomes the while condition, so
     shapes stay static under jit.
+  * ``null_text_optimization_fused`` — the same optimization as ONE jitted
+    device program with the trajectory buffer donated: scan outer,
+    while_loop inner, the convergence predicate carried on-device, and a
+    ``null_text_precision`` knob. ``"mixed"`` runs the UNet forwards in
+    bf16 (the tensors crossing the UNet boundary are cast down; pair with a
+    bf16-compute ``unet_fn`` for the full MXU win) while the scheduler
+    coefficients (core/ddim.py fp32 islands), the Adam state, and the
+    loss/early-stop accumulation all stay float32 — the precision split
+    that keeps the reconstruction inside the fixed-work PSNR band
+    (tests/test_null_text_precision.py pins it at tiny scale).
 
 The reference's Python-loop-with-break structure is the hard functionalization
 case SURVEY §7 ranks #3; the while_loop preserves its exact update-then-check
@@ -35,12 +45,29 @@ from videop2p_tpu.pipelines.cached import CachedSource, filter_site_tree
 from videop2p_tpu.pipelines.sampling import UNetFn
 from videop2p_tpu.pipelines.stores import blend_maps_from_store
 
-__all__ = ["ddim_inversion", "ddim_inversion_captured", "null_text_optimization"]
+__all__ = [
+    "ddim_inversion",
+    "ddim_inversion_captured",
+    "null_text_optimization",
+    "null_text_optimization_fused",
+]
 
-# jitted chunk scans for the outer_chunk path, keyed by the statics their
-# closures bake in (runtime arrays enter as jit inputs); bounded FIFO
+# jitted programs for the outer_chunk and fused paths, keyed by the statics
+# their closures bake in (runtime arrays enter as jit inputs); bounded FIFO
 _CHUNK_SCAN_CACHE: dict = {}
 _CHUNK_SCAN_CACHE_MAX = 4
+_FUSED_PROGRAM_CACHE: dict = {}
+_FUSED_PROGRAM_CACHE_MAX = 4
+
+_NULL_TEXT_PRECISIONS = ("fp32", "mixed")
+
+
+def _cache_put(cache: dict, cache_max: int, key, value) -> None:
+    """Bounded FIFO insert: fresh unet_fn/scheduler objects per pipeline
+    would otherwise pin executables forever in a long-lived process."""
+    while len(cache) >= cache_max:
+        cache.pop(next(iter(cache)))
+    cache[key] = value
 
 
 def ddim_inversion(
@@ -136,8 +163,12 @@ def ddim_inversion_captured(
     cliff: per spatial position it holds an F×F map, so its bytes grow
     quadratically with frame count (8f: 0.6 GiB → 24f: 5.8 GiB at SD
     scale) while everything else grows linearly. Probabilities live in
-    [0, 1] where e4m3 keeps ~2 significant digits; the maps are read back
-    upcast to the compute dtype (cached.py ``base_tree_at``), they only
+    [0, 1] where e4m3's 3 mantissa bits give a ~6 % relative step (about
+    one significant decimal digit), and values below ~2e-3 land in
+    subnormals or flush to zero — the real acceptance gate is the
+    empirical edit-output delta test (tests/test_cached.py), not a digits
+    figure; the maps are read back
+    upcast to the sibling captured maps' dtype (cached.py ``base_tree_at``), they only
     feed the EDIT stream's map replacement, and the source-stream replay
     is ε-based — its bit-exactness guarantee is unaffected
     (tests/test_cached.py pins both properties).
@@ -262,12 +293,14 @@ def null_text_optimization(
     guidance_scale: float = 7.5,
     num_inner_steps: int = 10,
     epsilon: float = 1e-5,
+    null_text_precision: str = "fp32",
     dependent_weight: float = 0.0,
     dependent_sampler: Optional[DependentNoiseSampler] = None,
     key: Optional[jax.Array] = None,
     outer_chunk: Optional[int] = None,
     early_stop: bool = True,
     return_losses: bool = False,
+    return_inner_steps: bool = False,
 ) -> jax.Array:
     """Optimize a per-step unconditional embedding that makes CFG denoising
     replay the recorded inversion trajectory (run_videop2p.py:580-612).
@@ -295,17 +328,43 @@ def null_text_optimization(
     run_videop2p.py:465-487; gradients flow through the ``(1-w)·ε̂`` term
     only) — so the objective matches the model that produced the trajectory.
 
+    ``null_text_precision``: ``"fp32"`` (default — the reference's Stage-2
+    behavior) or ``"mixed"``. Mixed casts the tensors crossing the UNet
+    boundary (latents and text embeddings) to bf16 before every forward and
+    upcasts the predictions back; the scheduler steps (fp32 islands,
+    core/ddim.py), the Adam moments, the CFG combine, and the loss /
+    early-stop accumulation all stay float32. With a bf16-compute
+    ``unet_fn`` this runs the inner-loop forwards+backward at full MXU
+    rate; with an fp32 ``unet_fn`` it still bounds the activation dtype at
+    the boundary (the parity test gates both).
+
+    ``return_inner_steps``: also return the number of inner Adam updates
+    each outer step actually took (num_steps,) int32 — the early-stop
+    observability the fused-vs-host parity test pins.
+
     ``outer_chunk``: split the outer scan into host-level jitted chunks of
     this many steps (one compile, several executions). At SD scale the full
     50-step program is a single multi-minute device call, which the TPU
     runtime's execution watchdog kills — chunking keeps each call short.
     Only valid OUTSIDE jit (the function then jits its own chunk scan).
+    For the single-dispatch donated-buffer variant see
+    :func:`null_text_optimization_fused`.
     """
+    if null_text_precision not in _NULL_TEXT_PRECISIONS:
+        raise ValueError(
+            f"null_text_precision {null_text_precision!r} not in "
+            f"{_NULL_TEXT_PRECISIONS}"
+        )
     if dependent_weight > 0.0 and dependent_sampler is None:
         raise ValueError("dependent_weight > 0 requires dependent_sampler")
     if key is None:
         key = jax.random.key(0)
     timesteps = jnp.asarray(scheduler.timesteps(num_inference_steps))
+    # the optimized variable and its Adam moments are float32 in EVERY
+    # precision mode (a bf16 text encoder hands over a bf16 uncond); the
+    # trajectory targets likewise — loss accumulation must be fp32
+    uncond_embedding = uncond_embedding.astype(jnp.float32)
+    trajectory = trajectory.astype(jnp.float32)
     # latent_prev for outer step i is trajectory[num - i - 1]
     # (the reference's latents[len - i - 2], run_videop2p.py:585)
     prev_seq = trajectory[::-1][1:]
@@ -314,8 +373,19 @@ def null_text_optimization(
     # hardcodes 50) cannot flip the update into gradient ascent
     lr_seq = jnp.maximum(1e-2 * (1.0 - steps / 100.0), 0.0)
     thresh_seq = epsilon + steps * 2e-5  # run_videop2p.py:603
-    # Adam direction with unit lr; the decayed per-step lr scales the update
+    # Adam direction with unit lr; the decayed per-step lr scales the update;
+    # moments and updates live in the embedding's own float32 — the Adam
+    # state is never narrowed in mixed mode
     adam = optax.adam(1.0)
+    # mixed precision: only the tensors CROSSING the UNet boundary narrow to
+    # bf16; predictions upcast to float32 the moment they come back, so the
+    # CFG combine, the scheduler islands, and the loss all accumulate fp32
+    mixed = null_text_precision == "mixed"
+    cast_in = (lambda a: a.astype(jnp.bfloat16)) if mixed else (lambda a: a)
+
+    def fwd(params, latent, t, text):
+        eps, _ = unet_fn(params, cast_in(latent), t, cast_in(text), None)
+        return eps.astype(jnp.float32)
 
     def blend(eps, key):
         if dependent_weight <= 0.0:
@@ -327,13 +397,13 @@ def null_text_optimization(
         latent_cur, uncond, key, params, cond_embedding = carry
         t, latent_prev, lr, thresh = xs
         key, k_cond, k_fu, k_fc = jax.random.split(key, 4)
-        eps, _ = unet_fn(params, latent_cur, t, cond_embedding, None)
-        eps_cond_raw = jax.lax.stop_gradient(eps)
+        eps_cond_raw = jax.lax.stop_gradient(
+            fwd(params, latent_cur, t, cond_embedding)
+        )
         eps_cond = blend(eps_cond_raw, k_cond)
 
         def loss_fn(u, k):
-            eps_uncond, _ = unet_fn(params, latent_cur, t, u, None)
-            eps_uncond = blend(eps_uncond, k)
+            eps_uncond = blend(fwd(params, latent_cur, t, u), k)
             eps = eps_uncond + guidance_scale * (eps_cond - eps_uncond)
             prev_rec = scheduler.prev_step(eps, t, latent_cur, num_inference_steps)
             return jnp.mean((prev_rec - latent_prev) ** 2)
@@ -353,20 +423,24 @@ def null_text_optimization(
             return (u, opt_state, loss, j + 1, k)
 
         opt_state = adam.init(uncond)
-        uncond, _, final_loss, _, key = jax.lax.while_loop(
-            inner_cond, inner_body, (uncond, opt_state, jnp.inf, 0, key)
+        uncond, _, final_loss, inner_taken, key = jax.lax.while_loop(
+            inner_cond,
+            inner_body,
+            (uncond, opt_state, jnp.asarray(jnp.inf, jnp.float32),
+             jnp.asarray(0, jnp.int32), key),
         )
 
         # advance with the optimized embedding under full CFG; the reference
         # blends the batched (2B) prediction with one batched draw — i.e.
         # independent fresh noise per half (run_videop2p.py:474-487,606-610);
         # the cond prediction is deterministic so its raw value is reused
-        eps_uncond, _ = unet_fn(params, latent_cur, t, uncond, None)
-        eps_uncond = blend(eps_uncond, k_fu)
+        eps_uncond = blend(fwd(params, latent_cur, t, uncond), k_fu)
         eps_c = blend(eps_cond_raw, k_fc)
         eps = eps_uncond + guidance_scale * (eps_c - eps_uncond)
         latent_cur = scheduler.prev_step(eps, t, latent_cur, num_inference_steps)
-        return (latent_cur, uncond, key, params, cond_embedding), (uncond, final_loss)
+        return (latent_cur, uncond, key, params, cond_embedding), (
+            uncond, final_loss, inner_taken,
+        )
 
     x_t = trajectory[-1]
     xs = (timesteps, prev_seq, lr_seq, thresh_seq)
@@ -382,11 +456,19 @@ def null_text_optimization(
 
         return body
 
+    def pack(uncond_seq, losses, inner_taken):
+        out = (uncond_seq,)
+        if return_losses:
+            out += (losses,)
+        if return_inner_steps:
+            out += (inner_taken,)
+        return out if len(out) > 1 else out[0]
+
     if not outer_chunk or outer_chunk >= num_inference_steps:
-        _, (uncond_seq, losses) = jax.lax.scan(
+        _, (uncond_seq, losses, inner_taken) = jax.lax.scan(
             make_body(params, cond_embedding), (x_t, uncond_embedding, key), xs
         )
-        return (uncond_seq, losses) if return_losses else uncond_seq
+        return pack(uncond_seq, losses, inner_taken)
 
     # chunked path: params/cond enter as plain jit inputs (same no-carry rule
     # as above), and the jitted chunk scan is cached on the statics its
@@ -394,7 +476,7 @@ def null_text_optimization(
     cache_key = (
         unet_fn, id(scheduler), id(dependent_sampler), float(guidance_scale),
         int(num_inner_steps), int(num_inference_steps), float(dependent_weight),
-        bool(early_stop),
+        bool(early_stop), null_text_precision,
     )
     chunk_scan = _CHUNK_SCAN_CACHE.get(cache_key)
     if chunk_scan is None:
@@ -402,20 +484,122 @@ def null_text_optimization(
         def chunk_fn(p, cond, small_carry, chunk_xs):
             return jax.lax.scan(make_body(p, cond), small_carry, chunk_xs)
 
-        while len(_CHUNK_SCAN_CACHE) >= _CHUNK_SCAN_CACHE_MAX:
-            # bounded: fresh unet_fn/scheduler objects per pipeline would
-            # otherwise pin executables forever in a long-lived process
-            _CHUNK_SCAN_CACHE.pop(next(iter(_CHUNK_SCAN_CACHE)))
         chunk_scan = jax.jit(chunk_fn)
-        _CHUNK_SCAN_CACHE[cache_key] = chunk_scan
+        _cache_put(_CHUNK_SCAN_CACHE, _CHUNK_SCAN_CACHE_MAX, cache_key, chunk_scan)
     small = (x_t, uncond_embedding, key)
-    pieces, loss_pieces = [], []
+    pieces, loss_pieces, step_pieces = [], [], []
     for start in range(0, num_inference_steps, outer_chunk):
         chunk = jax.tree.map(lambda a: a[start : start + outer_chunk], xs)
-        small, (seq, losses) = chunk_scan(params, cond_embedding, small, chunk)
+        small, (seq, losses, taken) = chunk_scan(params, cond_embedding, small, chunk)
         pieces.append(seq)
         loss_pieces.append(losses)
-    uncond_seq = jnp.concatenate(pieces, axis=0)
-    if return_losses:
-        return uncond_seq, jnp.concatenate(loss_pieces, axis=0)
+        step_pieces.append(taken)
+    return pack(
+        jnp.concatenate(pieces, axis=0),
+        jnp.concatenate(loss_pieces, axis=0),
+        jnp.concatenate(step_pieces, axis=0),
+    )
+
+
+def null_text_optimization_fused(
+    unet_fn: UNetFn,
+    params,
+    scheduler: DDIMScheduler,
+    trajectory: jax.Array,
+    cond_embedding: jax.Array,
+    uncond_embedding: jax.Array,
+    *,
+    num_inference_steps: int = 50,
+    guidance_scale: float = 7.5,
+    num_inner_steps: int = 10,
+    epsilon: float = 1e-5,
+    null_text_precision: str = "fp32",
+    dependent_weight: float = 0.0,
+    dependent_sampler: Optional[DependentNoiseSampler] = None,
+    key: Optional[jax.Array] = None,
+    early_stop: bool = True,
+    donate: bool = True,
+    return_stats: bool = False,
+):
+    """Null-text optimization as ONE jitted, donated-carry device program.
+
+    The host-driven structure (an outer Python/jit-chunk loop re-dispatching
+    per segment) pays a tunnel round trip per dispatch and re-uploads the
+    scan constants each time; here the whole 50-step outer scan — inner
+    bounded ``lax.while_loop`` Adam with the convergence predicate carried
+    on-device — compiles to a single XLA program, dispatched once. The
+    trajectory buffer (the largest input, ~270 MB at SD scale 8f) is DONATED
+    to the program by default: XLA reuses it for scan temporaries instead of
+    holding input + workspace side by side. Callers that still need the
+    trajectory afterwards must pass ``donate=False`` (the CLI extracts x_T
+    before optimizing, so its buffer is free to donate).
+
+    Precision follows ``null_text_precision`` exactly as in
+    :func:`null_text_optimization` (which this wraps): bf16 UNet forwards in
+    ``"mixed"`` with fp32 scheduler coefficients (core/ddim.py islands),
+    fp32 Adam state, and fp32 loss/early-stop accumulation.
+
+    Watchdog note: at SD scale the fp32 fixed-10 program can be a
+    multi-minute single device call — the TPU runtime's execution watchdog
+    territory that motivated ``outer_chunk``. The mixed program cuts that
+    wall-clock ~3-4×; if a deployment still trips the watchdog, fall back to
+    ``null_text_optimization(outer_chunk=...)`` (the CLI exposes
+    ``--null_text_chunk`` for exactly this).
+
+    Returns the per-step uncond embeddings (num_steps, B, L, D); with
+    ``return_stats=True`` returns ``(uncond_seq, stats)`` where ``stats`` is
+    ``{"final_loss": (num_steps,) float32, "inner_steps": (num_steps,)
+    int32}`` — the reconstruction objective per outer step and the inner
+    Adam updates its early stop actually took.
+    """
+    if null_text_precision not in _NULL_TEXT_PRECISIONS:
+        raise ValueError(
+            f"null_text_precision {null_text_precision!r} not in "
+            f"{_NULL_TEXT_PRECISIONS}"
+        )
+    if dependent_weight > 0.0 and dependent_sampler is None:
+        raise ValueError("dependent_weight > 0 requires dependent_sampler")
+    if key is None:
+        key = jax.random.key(0)
+    # the CPU backend cannot alias donated buffers — requesting donation
+    # there only produces an unusable-donation warning per call
+    donate = donate and jax.default_backend() != "cpu"
+
+    cache_key = (
+        unet_fn, id(scheduler), id(dependent_sampler), float(guidance_scale),
+        int(num_inner_steps), int(num_inference_steps), float(dependent_weight),
+        float(epsilon), bool(early_stop), null_text_precision, bool(donate),
+    )
+    program = _FUSED_PROGRAM_CACHE.get(cache_key)
+    if program is None:
+
+        def program_fn(p, cond, traj, uncond, k):
+            return null_text_optimization(
+                unet_fn, p, scheduler, traj, cond, uncond,
+                num_inference_steps=num_inference_steps,
+                guidance_scale=guidance_scale,
+                num_inner_steps=num_inner_steps,
+                epsilon=epsilon,
+                null_text_precision=null_text_precision,
+                dependent_weight=dependent_weight,
+                dependent_sampler=dependent_sampler,
+                key=k,
+                early_stop=early_stop,
+                return_losses=True,
+                return_inner_steps=True,
+            )
+
+        # argnum 2 = the trajectory, the only buffer worth donating (the
+        # uncond embedding is KB-scale and callers routinely reuse theirs)
+        program = jax.jit(
+            program_fn, donate_argnums=(2,) if donate else ()
+        )
+        _cache_put(_FUSED_PROGRAM_CACHE, _FUSED_PROGRAM_CACHE_MAX,
+                   cache_key, program)
+
+    uncond_seq, losses, inner_taken = program(
+        params, cond_embedding, trajectory, uncond_embedding, key
+    )
+    if return_stats:
+        return uncond_seq, {"final_loss": losses, "inner_steps": inner_taken}
     return uncond_seq

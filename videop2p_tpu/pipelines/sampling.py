@@ -37,10 +37,15 @@ from videop2p_tpu.models.attention import AttnControl
 from videop2p_tpu.pipelines.cached import CachedSource
 from videop2p_tpu.pipelines.stores import blend_maps_from_store
 
-__all__ = ["edit_sample", "make_unet_fn"]
+__all__ = ["edit_sample", "make_unet_fn", "official_edit"]
 
 # (params, sample, t, text, control) -> (eps, attn_store)
 UNetFn = Callable[..., Tuple[jax.Array, dict]]
+
+# jitted official-mode programs, keyed on the statics their closures bake in
+# (bounded FIFO — same discipline as inversion.py's program caches)
+_OFFICIAL_EDIT_CACHE: dict = {}
+_OFFICIAL_EDIT_CACHE_MAX = 4
 
 
 def make_unet_fn(model) -> UNetFn:
@@ -449,3 +454,118 @@ def _edit_sample_cached(
     (edit_latents, _), _ = jax.lax.scan(body, (edit_latents, maps_sum), xs)
     # stream 0 = the exact inversion reconstruction (trajectory[0] = x_0)
     return jnp.concatenate([cached.src_latents[-1], edit_latents], axis=0)
+
+
+def official_edit(
+    unet_fn: UNetFn,
+    params,
+    scheduler: DDIMScheduler,
+    trajectory: jax.Array,
+    cond_embeddings: jax.Array,
+    uncond_embedding: jax.Array,
+    *,
+    num_inference_steps: int = 50,
+    guidance_scale: float = 7.5,
+    ctx: Optional[ControlContext] = None,
+    num_inner_steps: int = 10,
+    epsilon: float = 1e-5,
+    null_text_precision: str = "fp32",
+    early_stop: bool = True,
+    dependent_weight: float = 0.0,
+    dependent_sampler: Optional[DependentNoiseSampler] = None,
+    eta: float = 0.0,
+    key: Optional[jax.Array] = None,
+    blend_res: Optional[Tuple[int, int]] = None,
+    donate: bool = True,
+    return_null_stats: bool = False,
+):
+    """The full official mode — null-text optimization plus the controlled
+    full-CFG edit — as ONE jitted device program.
+
+    The split flow surfaces the optimized uncond trajectory
+    (num_steps, 1, L, D) on the host between phases: a device→host→device
+    round trip plus a second program dispatch, each riding the tunnel. Here
+    :func:`edit_sample` consumes the optimized sequence straight out of the
+    null-text scan — the embeddings never materialize outside the program,
+    and the trajectory buffer is donated to it (``donate=False`` if the
+    caller still needs it). HBM note: this holds the null-text grad program
+    and the CFG edit program in ONE executable — at fp32 SD scale that can
+    exceed a 16 GB chip (the CLI's phase-split + ``jax.clear_caches()``
+    exists for that reason); the bf16/``mixed`` working points fit.
+
+    ``trajectory``: (num_steps+1, B=1, F, h, w, C) from
+    :func:`~videop2p_tpu.pipelines.inversion.ddim_inversion`;
+    ``cond_embeddings``: (P, L, D), source prompt first;
+    ``uncond_embedding``: (L, D) or (1, L, D).
+
+    Returns final latents (P, F, h, w, C); with ``return_null_stats=True``
+    returns ``(latents, stats)`` — the fused null-text program's
+    ``{"final_loss", "inner_steps"}`` record.
+    """
+    # lazy import: inversion.py imports this module for the UNetFn contract
+    from videop2p_tpu.pipelines.inversion import null_text_optimization
+
+    if uncond_embedding.ndim == 3 and uncond_embedding.shape[0] == 1:
+        uncond_embedding = uncond_embedding[0]
+    if uncond_embedding.ndim != 2:
+        raise ValueError(
+            f"uncond_embedding must be (L, D) or (1, L, D), got "
+            f"{uncond_embedding.shape}"
+        )
+    if key is None:
+        key = jax.random.key(0)
+    # CPU cannot alias donated buffers — avoid the per-call warning
+    donate = donate and jax.default_backend() != "cpu"
+
+    cache_key = (
+        unet_fn, id(scheduler), id(dependent_sampler), id(ctx),
+        float(guidance_scale), int(num_inner_steps), int(num_inference_steps),
+        float(dependent_weight), float(epsilon), float(eta),
+        bool(early_stop), null_text_precision, blend_res, bool(donate),
+    )
+    program = _OFFICIAL_EDIT_CACHE.get(cache_key)
+    if program is None:
+
+        def program_fn(p, cond, uncond, traj, k):
+            k_null, k_edit = jax.random.split(k)
+            null_seq, losses, inner_taken = null_text_optimization(
+                unet_fn, p, scheduler, traj, cond[:1], uncond[None],
+                num_inference_steps=num_inference_steps,
+                guidance_scale=guidance_scale,
+                num_inner_steps=num_inner_steps,
+                epsilon=epsilon,
+                null_text_precision=null_text_precision,
+                dependent_weight=dependent_weight,
+                dependent_sampler=dependent_sampler,
+                key=k_null,
+                early_stop=early_stop,
+                return_losses=True,
+                return_inner_steps=True,
+            )
+            out = edit_sample(
+                unet_fn, p, scheduler, traj[-1], cond, uncond,
+                num_inference_steps=num_inference_steps,
+                guidance_scale=guidance_scale,
+                ctx=ctx,
+                source_uses_cfg=True,
+                eta=eta,
+                key=k_edit,
+                dependent_sampler=dependent_sampler if eta > 0 else None,
+                blend_res=blend_res,
+                null_uncond_embeddings=null_seq,
+            )
+            return out, losses, inner_taken
+
+        program = jax.jit(
+            program_fn, donate_argnums=(3,) if donate else ()
+        )
+        while len(_OFFICIAL_EDIT_CACHE) >= _OFFICIAL_EDIT_CACHE_MAX:
+            _OFFICIAL_EDIT_CACHE.pop(next(iter(_OFFICIAL_EDIT_CACHE)))
+        _OFFICIAL_EDIT_CACHE[cache_key] = program
+
+    out, losses, inner_taken = program(
+        params, cond_embeddings, uncond_embedding, trajectory, key
+    )
+    if return_null_stats:
+        return out, {"final_loss": losses, "inner_steps": inner_taken}
+    return out
