@@ -209,6 +209,54 @@ def test_details_recorder_merges_and_flags_stale(bench, tmp_path):
     assert "a_s" not in saved.get("stale_from_previous_run", [])
 
 
+# ------------------------------------------------- ledger/compile fields --
+
+
+def test_ledger_bench_fields_schema(bench):
+    """The bench breakdown's ledger/compile provenance fields (ISSUE 2):
+    schema-stable and machine-readable, with the compile-vs-execute split
+    explicit. Values may be null when unmeasured, keys never vanish."""
+    rec = bench.ledger_bench_fields(
+        "/tmp/bench_ledger.jsonl", [1.5, 2.25, 0.25], execute_s=8.0
+    )
+    assert rec == {
+        "ledger_path": "/tmp/bench_ledger.jsonl",
+        "compile_events": 3,
+        "compile_total_s": 4.0,
+        "execute_headline_s": 8.0,
+        "compile_vs_execute": 0.5,
+    }
+    # unmeasured execute: keys stay, split is null (not a division crash)
+    empty = bench.ledger_bench_fields("p", [], execute_s=None)
+    assert empty["compile_events"] == 0
+    assert empty["compile_total_s"] == 0.0
+    assert empty["execute_headline_s"] is None
+    assert empty["compile_vs_execute"] is None
+    assert set(empty) == set(rec)
+
+
+def test_no_wall_clock_in_timed_regions():
+    """Satellite guard (ISSUE 2): every timed region in the package uses
+    the monotonic clock — ``time.time()`` steps under NTP adjustment and
+    corrupted phase records. Grep-based so a reintroduction anywhere in
+    videop2p_tpu/ fails loudly with the offending lines."""
+    offenders = []
+    pkg = os.path.join(_REPO, "videop2p_tpu")
+    for root, _, files in os.walk(pkg):
+        for name in files:
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(root, name)
+            with open(path) as f:
+                for lineno, line in enumerate(f, 1):
+                    if "time.time()" in line:
+                        offenders.append(f"{path}:{lineno}: {line.strip()}")
+    assert not offenders, (
+        "time.time() reintroduced in a timed region — use "
+        "time.perf_counter():\n" + "\n".join(offenders)
+    )
+
+
 # ---------------------------------------------------- __graft_entry__.py --
 
 

@@ -1,0 +1,526 @@
+"""Observability subsystem (videop2p_tpu/obs): in-program telemetry + the
+unified run ledger (ISSUE 2).
+
+CPU gates for the tentpole's contracts:
+
+  * telemetry buffers are fixed-shape and shape-stable under jit — NaNs in
+    the data change values, never shapes;
+  * telemetry OFF leaves the fused programs' outputs bit-exact (null-text
+    fused, the controlled edit, the cached replay — whose source stream
+    must stay exactly the inversion input);
+  * the ledger JSONL schema round-trips, compile events are captured on
+    CPU with program attribution, phase_timer emits into the active
+    ledger, and tools/ledger_summary.py renders a real event stream;
+  * the telemetry-on overhead of the fused null-text program is measured
+    on a compute-dominated smoke workload and recorded in a ledger.
+
+Fake denoisers keep everything eager-CPU-fast (the SURVEY §4 strategy).
+"""
+
+import importlib.util
+import json
+import os
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from videop2p_tpu.core import DDIMScheduler
+from videop2p_tpu.obs import (
+    RunLedger,
+    current_ledger,
+    decode_null_text_stats,
+    decode_step_stats,
+    instrumented_jit,
+    latent_stats,
+    read_ledger,
+    sparkline,
+    summarize_step_stats,
+    telemetry_overhead_record,
+)
+from videop2p_tpu.obs.telemetry import measure_overhead
+from videop2p_tpu.pipelines import (
+    ddim_inversion,
+    edit_sample,
+    null_text_optimization,
+    null_text_optimization_fused,
+)
+
+STEPS = 6
+SHAPE = (1, 2, 8, 8, 4)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def sched():
+    return DDIMScheduler.create_sd()
+
+
+def text_unet():
+    def fn(params, sample, t, text, control=None):
+        bias = jnp.mean(text, axis=(1, 2))
+        return 0.1 * sample + bias[:, None, None, None, None], {}
+
+    return fn
+
+
+@pytest.fixture(scope="module")
+def problem(sched):
+    fn = text_unet()
+    x0 = jax.random.normal(jax.random.key(0), SHAPE)
+    cond = 0.3 * jnp.ones((1, 77, 8))
+    uncond = jnp.zeros((1, 77, 8))
+    traj = ddim_inversion(fn, None, sched, x0, cond, num_inference_steps=STEPS)
+    return fn, x0, cond, uncond, traj
+
+
+# ------------------------------------------------------------- telemetry --
+
+
+def test_latent_stats_shape_stable_under_jit():
+    """The probe returns SCALARS whatever the data holds — a scan stacking
+    it yields (num_steps,) vectors, and NaN inputs change values only."""
+
+    def scan_stats(x):
+        def body(c, _):
+            return c * 2.0, latent_stats(c)
+
+        _, ys = jax.lax.scan(body, x, None, length=5)
+        return ys
+
+    clean = jax.jit(scan_stats)(jnp.ones((2, 3, 4)))
+    dirty = jax.jit(scan_stats)(
+        jnp.array([[1.0, jnp.nan], [jnp.inf, -2.0]])
+    )
+    for ys in (clean, dirty):
+        assert set(ys) == {"abs_max", "mean", "nan_count", "inf_count"}
+        for k, v in ys.items():
+            assert v.shape == (5,), k
+    assert int(dirty["nan_count"][0]) == 1
+    assert int(dirty["inf_count"][0]) == 1
+    # finite-masked stats: the NaN/inf never poison the curve
+    assert float(dirty["abs_max"][0]) == 2.0
+    assert np.isfinite(np.asarray(dirty["mean"])).all()
+    assert int(clean["nan_count"].sum()) == 0
+
+
+def test_null_text_fused_telemetry_off_is_bit_exact(problem, sched):
+    fn, _, cond, uncond, traj = problem
+    kw = dict(num_inference_steps=STEPS, num_inner_steps=3, return_stats=True)
+    seq_off, stats_off = null_text_optimization_fused(
+        fn, None, sched, traj, cond, uncond, **kw
+    )
+    seq_on, stats_on = null_text_optimization_fused(
+        fn, None, sched, traj, cond, uncond, telemetry=True, **kw
+    )
+    assert np.array_equal(np.asarray(seq_off), np.asarray(seq_on))
+    assert np.array_equal(np.asarray(stats_off["final_loss"]),
+                          np.asarray(stats_on["final_loss"]))
+    tel = stats_on["latent_stats"]
+    assert {k: np.asarray(v).shape for k, v in tel.items()} == {
+        "abs_max": (STEPS,), "mean": (STEPS,),
+        "nan_count": (STEPS,), "inf_count": (STEPS,),
+    }
+    assert int(np.asarray(tel["nan_count"]).sum()) == 0
+    # the decoded record is ledger-ready: loss curve + inner steps + latent
+    rec = decode_null_text_stats(stats_on)
+    assert len(rec["loss_curve"]) == STEPS
+    assert rec["inner_steps_total"] == sum(rec["inner_steps"])
+    assert rec["latent"]["nan_total"] == 0
+
+
+def test_null_text_telemetry_requires_stats(problem, sched):
+    fn, _, cond, uncond, traj = problem
+    with pytest.raises(ValueError, match="return_stats"):
+        null_text_optimization_fused(
+            fn, None, sched, traj, cond, uncond,
+            num_inference_steps=STEPS, telemetry=True,
+        )
+
+
+def test_null_text_chunked_telemetry_matches_fused(problem, sched):
+    """The host-chunked watchdog fallback stacks the same telemetry as the
+    fused program (chunk boundaries concatenate, values identical)."""
+    fn, _, cond, uncond, traj = problem
+    kw = dict(num_inference_steps=STEPS, num_inner_steps=2)
+    _, stats = null_text_optimization_fused(
+        fn, None, sched, traj, cond, uncond,
+        return_stats=True, telemetry=True, **kw,
+    )
+    seq_c, tel_c = null_text_optimization(
+        fn, None, sched, traj, cond, uncond,
+        outer_chunk=2, telemetry=True, **kw,
+    )
+    assert seq_c.shape[0] == STEPS
+    for k, v in stats["latent_stats"].items():
+        np.testing.assert_allclose(
+            np.asarray(tel_c[k]), np.asarray(v), rtol=0, atol=0, err_msg=k
+        )
+
+
+def test_edit_sample_telemetry_off_is_bit_exact(problem, sched):
+    fn, _, cond, uncond, traj = problem
+    cond2 = jnp.concatenate([cond, 0.5 * jnp.ones((1, 77, 8))], axis=0)
+
+    out_off = jax.jit(
+        lambda xt: edit_sample(fn, None, sched, xt, cond2, uncond[0],
+                               num_inference_steps=STEPS)
+    )(traj[-1])
+    out_on, tel = jax.jit(
+        lambda xt: edit_sample(fn, None, sched, xt, cond2, uncond[0],
+                               num_inference_steps=STEPS, telemetry=True)
+    )(traj[-1])
+    assert np.array_equal(np.asarray(out_off), np.asarray(out_on))
+    assert set(tel) == {"abs_max", "mean", "nan_count", "inf_count",
+                        "cross_gate_mean", "self_edit_active"}
+    for v in tel.values():
+        assert np.asarray(v).shape == (STEPS,)
+    # no controller: the edit-gate channels are identically zero
+    assert float(np.asarray(tel["cross_gate_mean"]).sum()) == 0.0
+    assert int(np.asarray(tel["self_edit_active"]).sum()) == 0
+    summary = summarize_step_stats(tel)
+    assert summary["steps"] == STEPS and summary["nan_total"] == 0
+    assert len(decode_step_stats(tel)) == STEPS
+
+
+def test_cached_edit_telemetry_keeps_exact_replay(problem, sched):
+    """Telemetry through the cached-source path: outputs bit-exact vs
+    telemetry-off, and stream 0 stays the EXACT inversion input — the
+    src_err == 0.0 guarantee the multichip dryrun reports."""
+    from videop2p_tpu.pipelines import cached_fast_edit
+
+    fn, x0, cond, uncond, _ = problem
+    cond2 = jnp.concatenate([cond, 0.5 * jnp.ones((1, 77, 8))], axis=0)
+    kw = dict(num_inference_steps=STEPS, cross_len=0, self_window=(0, 0))
+    traj_off, edited_off = jax.jit(
+        lambda x: cached_fast_edit(fn, None, sched, x, cond, cond2,
+                                   uncond[0], None, **kw)
+    )(x0)
+    traj_on, edited_on, tel = jax.jit(
+        lambda x: cached_fast_edit(fn, None, sched, x, cond, cond2,
+                                   uncond[0], None, telemetry=True, **kw)
+    )(x0)
+    assert np.array_equal(np.asarray(edited_off), np.asarray(edited_on))
+    assert np.array_equal(np.asarray(traj_off), np.asarray(traj_on))
+    src_err = float(jnp.max(jnp.abs(edited_on[0] - x0[0])))
+    assert src_err == 0.0
+    assert np.asarray(tel["abs_max"]).shape == (STEPS,)
+    assert int(np.asarray(tel["nan_count"]).sum()) == 0
+
+
+def test_train_steps_telemetry_grad_norms():
+    """Training telemetry: same losses bit-exact, plus finite per-step
+    pre-clip global gradient norms stacked by the same scan."""
+    from videop2p_tpu.core import DDPMScheduler
+    from videop2p_tpu.models import UNet3DConditionModel, UNet3DConfig
+    from videop2p_tpu.pipelines import make_unet_fn
+    from videop2p_tpu.train import (
+        TrainState, TuneConfig, make_optimizer, train_steps,
+    )
+
+    cfg = UNet3DConfig.tiny()
+    model = UNet3DConditionModel(config=cfg)
+    latents = 0.3 * jax.random.normal(jax.random.key(0), (1, 2, 8, 8, 4))
+    text = jax.random.normal(jax.random.key(1), (1, 7, cfg.cross_attention_dim))
+    variables = jax.jit(model.init)(jax.random.key(2), latents, jnp.asarray(0), text)
+    fn = make_unet_fn(model)
+    tune_cfg = TuneConfig(max_train_steps=3)
+    tx = make_optimizer(tune_cfg)
+    noise_sched = DDPMScheduler.create_sd()
+    key = jax.random.key(3)
+
+    state0 = TrainState.create(dict(variables)["params"], tx)
+    _, losses = train_steps(fn, tx, state0, noise_sched, latents, text, key,
+                            num_steps=3)
+    state1 = TrainState.create(dict(variables)["params"], tx)
+    _, losses_t, gnorms = train_steps(fn, tx, state1, noise_sched, latents,
+                                      text, key, num_steps=3, telemetry=True)
+    np.testing.assert_array_equal(np.asarray(losses), np.asarray(losses_t))
+    g = np.asarray(gnorms)
+    assert g.shape == (3,) and np.isfinite(g).all() and (g > 0).all()
+
+
+# ---------------------------------------------------------------- ledger --
+
+
+def test_ledger_schema_round_trips(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    with RunLedger(path, run_id="t1", meta={"cli": "test"}) as led:
+        assert current_ledger() is led
+        led.phase("p", 1.25, count=3, unit="it")
+        led.telemetry("prog", {"loss_curve": [1.0, 0.5], "loss_final": 0.5})
+        led.memory_snapshot(note="now")
+        led.event("custom", answer=42)
+    assert current_ledger() is None
+    events = read_ledger(path)
+    by_kind = {e["event"]: e for e in events}
+    start = by_kind["run_start"]
+    assert start["run_id"] == "t1" and start["cli"] == "test"
+    assert start["jax_version"] == jax.__version__
+    assert "backend" in start
+    assert by_kind["phase"]["name"] == "p"
+    assert by_kind["phase"]["seconds"] == 1.25
+    assert by_kind["telemetry"]["program"] == "prog"
+    assert by_kind["memory"]["supported"] in (True, False)
+    assert by_kind["custom"]["answer"] == 42
+    assert events[-1]["event"] == "run_end"
+    # every event is one JSON object per line with a monotonic t
+    raw = [json.loads(l) for l in open(path) if l.strip()]
+    assert [e["event"] for e in raw] == [e["event"] for e in events]
+    ts = [e["t"] for e in events]
+    assert ts == sorted(ts)
+
+
+def test_compile_events_captured_on_cpu(tmp_path):
+    """The jax.monitoring listener lands backend-compile durations in the
+    active ledger, attributed to the instrumented program; a cache hit
+    records a program_call with cache_miss=False and no new compile."""
+    path = str(tmp_path / "ledger.jsonl")
+    with RunLedger(path) as led:
+        f = instrumented_jit(lambda x: x * 3 + 1, program="triple")
+        f(jnp.ones((4, 4)))
+        n_compiles_after_first = len(led.compile_seconds)
+        f(jnp.ones((4, 4)))
+    events = read_ledger(path)
+    compiles = [e for e in events if e["event"] == "compile"
+                and e.get("program") == "triple"]
+    assert len(compiles) >= 1
+    assert all(e["seconds"] > 0 for e in compiles)
+    calls = [e for e in events if e["event"] == "program_call"]
+    assert [c["cache_miss"] for c in calls] == [True, False]
+    # the second (hit) call triggered no further compile
+    assert len(led.compile_seconds) == n_compiles_after_first
+
+
+def test_phase_timer_emits_into_active_ledger(tmp_path, capsys):
+    from videop2p_tpu.utils.profiling import phase_records, phase_timer, reset
+
+    reset()
+    path = str(tmp_path / "ledger.jsonl")
+    with RunLedger(path):
+        with phase_timer("ledgered_phase", count=2, unit="u"):
+            pass
+    with phase_timer("unledgered_phase", verbose=False):
+        pass
+    events = [e for e in read_ledger(path) if e["event"] == "phase"]
+    assert [e["name"] for e in events] == ["ledgered_phase"]
+    assert events[0]["count"] == 2 and events[0]["unit"] == "u"
+    # the process-local records caught both, and reset clears them
+    recs = phase_records()
+    assert set(recs) == {"ledgered_phase", "unledgered_phase"}
+    reset()
+    assert phase_records() == {}
+
+
+def test_phase_records_thread_safe():
+    from videop2p_tpu.utils.profiling import phase_records, phase_timer, reset
+
+    reset()
+
+    def work(i):
+        for _ in range(50):
+            with phase_timer(f"thread_{i}", verbose=False):
+                pass
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    recs = phase_records()
+    assert set(recs) == {f"thread_{i}" for i in range(4)}
+    reset()
+
+
+def test_metrics_logger_flushes_and_survives_abrupt_close(tmp_path):
+    """Satellite: scalars must survive an abrupt close — the JSONL line
+    buffer holds every step immediately, and the TensorBoard writer gets a
+    flush every ``flush_every`` logs plus flush-before-close."""
+    from videop2p_tpu.utils.metrics import MetricsLogger
+
+    class StubTB:
+        def __init__(self):
+            self.scalars, self.flushes, self.closed = [], 0, False
+
+        def add_scalar(self, k, v, step):
+            self.scalars.append((k, v, step))
+
+        def flush(self):
+            self.flushes += 1
+
+        def close(self):
+            self.closed = True
+
+    logger = MetricsLogger(str(tmp_path), use_tensorboard=False, flush_every=2)
+    logger._tb = StubTB()
+    for step in range(1, 6):
+        logger.log(step, {"train_loss": 1.0 / step})
+    # JSONL survives WITHOUT close: line-buffered append
+    lines = [json.loads(l) for l in open(logger.path)]
+    assert [l["step"] for l in lines] == [1, 2, 3, 4, 5]
+    assert all("wall_s" in l for l in lines)
+    assert logger._tb.flushes == 2  # every 2 logs
+    logger.close()
+    assert logger._tb.flushes == 3  # flush-on-close precedes close
+    assert logger._tb.closed
+
+
+def test_metrics_logger_is_a_ledger_view(tmp_path):
+    from videop2p_tpu.utils.metrics import MetricsLogger
+
+    path = str(tmp_path / "ledger.jsonl")
+    with RunLedger(path):
+        with MetricsLogger(str(tmp_path / "run"), use_tensorboard=False) as m:
+            m.log(1, {"train_loss": 0.5, "lr": 1e-4})
+    metric = [e for e in read_ledger(path) if e["event"] == "metric"]
+    assert len(metric) == 1
+    assert metric[0]["step"] == 1 and metric[0]["train_loss"] == 0.5
+
+
+def test_instrumented_jit_passthrough_without_ledger():
+    f = instrumented_jit(lambda x: x + 1, program="noop")
+    assert current_ledger() is None
+    assert float(f(jnp.asarray(1.0))) == 2.0
+
+
+# -------------------------------------------------------- ledger summary --
+
+
+def _load_summary_tool():
+    spec = importlib.util.spec_from_file_location(
+        "ledger_summary_under_test",
+        os.path.join(_REPO, "tools", "ledger_summary.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_ledger_summary_renders_real_stream(tmp_path, problem, sched):
+    """End-to-end: a ledger produced by real instrumented programs renders
+    without error and shows phases, programs, and the loss sparkline."""
+    from videop2p_tpu.utils.profiling import phase_timer
+
+    fn, _, cond, uncond, traj = problem
+    path = str(tmp_path / "ledger.jsonl")
+    with RunLedger(path, run_id="render") as led:
+        with phase_timer("null_text", verbose=False):
+            _, stats = null_text_optimization_fused(
+                fn, None, sched, traj, cond, uncond,
+                num_inference_steps=STEPS, num_inner_steps=2,
+                return_stats=True, telemetry=True,
+            )
+        led.telemetry("null_text_fused", decode_null_text_stats(stats))
+        led.memory_snapshot()
+    mod = _load_summary_tool()
+    text = mod.render(read_ledger(path))
+    assert "run render" in text
+    assert "null_text" in text
+    assert "loss" in text and "inner steps" in text
+    # sparkline characters (or the flat-series bar) present
+    assert any(c in text for c in "▁▂▃▄▅▆▇█")
+
+
+def test_sparkline_handles_degenerate_series():
+    assert sparkline([]) == ""
+    assert sparkline([1.0, 1.0, 1.0]) == "▄▄▄"
+    assert "!" in sparkline([1.0, float("nan"), 2.0])
+    assert len(sparkline(list(range(500)), width=50)) == 50
+
+
+# ------------------------------------------------- overhead (CPU smoke) --
+
+
+def test_telemetry_overhead_recorded_and_small(tmp_path, sched):
+    """The acceptance smoke: telemetry-on overhead of the fused null-text
+    program on a COMPUTE-DOMINATED workload (a matmul-heavy denoiser over a
+    small latent — the real UNet's FLOPs-per-latent-byte ratio is even more
+    extreme), recorded in a ledger. The stats are four scalar reductions
+    per outer step; once forwards dominate, their cost vanishes."""
+    W = 0.02 * jax.random.normal(jax.random.key(9), (512, 512))
+
+    def heavy_fn(params, sample, t, text, control=None):
+        h = sample.reshape(1, -1)
+        h = jnp.pad(h, ((0, 0), (0, 512 - h.shape[1])))
+        for _ in range(8):
+            h = jnp.tanh(h @ W)
+        bias = jnp.mean(text, axis=(1, 2)) + jnp.mean(h)
+        return 0.1 * sample + bias[:, None, None, None, None], {}
+
+    x0 = jax.random.normal(jax.random.key(0), SHAPE)
+    cond = 0.3 * jnp.ones((1, 77, 8))
+    uncond = jnp.zeros((1, 77, 8))
+    traj = ddim_inversion(heavy_fn, None, sched, x0, cond,
+                          num_inference_steps=STEPS)
+    kw = dict(num_inference_steps=STEPS, num_inner_steps=4,
+              early_stop=False, return_stats=True)
+
+    def run_off():
+        jax.block_until_ready(null_text_optimization_fused(
+            heavy_fn, None, sched, traj, cond, uncond, **kw)[0])
+
+    def run_on():
+        jax.block_until_ready(null_text_optimization_fused(
+            heavy_fn, None, sched, traj, cond, uncond, telemetry=True, **kw)[0])
+
+    rec = measure_overhead(run_off, run_on, repeats=3)
+    if rec["telemetry_overhead_pct"] > 5.0:  # one retry absorbs a CI blip
+        rec = measure_overhead(run_off, run_on, repeats=5)
+    path = str(tmp_path / "ledger.jsonl")
+    with RunLedger(path) as led:
+        led.telemetry("null_text_fused_overhead", rec)
+    saved = [e for e in read_ledger(path) if e["event"] == "telemetry"][0]
+    assert saved["telemetry_overhead_pct"] == rec["telemetry_overhead_pct"]
+    assert set(rec) == {"telemetry_off_s", "telemetry_on_s",
+                        "telemetry_overhead_pct"}
+    assert rec["telemetry_overhead_pct"] <= 5.0, rec
+
+
+def test_telemetry_overhead_record_schema():
+    rec = telemetry_overhead_record(2.0, 2.05)
+    assert rec == {"telemetry_off_s": 2.0, "telemetry_on_s": 2.05,
+                   "telemetry_overhead_pct": 2.5}
+
+
+# --------------------------------------------------------- CLI e2e (slow) --
+
+
+@pytest.mark.slow
+def test_cli_full_mode_writes_acceptance_ledger(tmp_path):
+    """The acceptance run: a full-mode (null-text) CLI edit with
+    --telemetry/--ledger writes a JSONL holding ≥1 compile event, ≥1 phase
+    event, and the decoded fused-null-text telemetry (loss curve +
+    inner-steps); ledger_summary renders it without error."""
+    from videop2p_tpu.cli.run_videop2p import main as p2p
+
+    ledger_path = str(tmp_path / "acceptance_ledger.jsonl")
+    inv_gif, edit_gif = p2p(
+        pretrained_model_path=str(tmp_path / "no_ckpt"),
+        image_path="data/rabbit",
+        prompt="a rabbit is jumping",
+        prompts=["a rabbit is jumping", "a origami rabbit is jumping"],
+        save_name="origami", is_word_swap=False,
+        video_len=2, fast=False, tiny=True, num_inner_steps=2,
+        telemetry=True, ledger=ledger_path, reuse_inversion=False,
+    )
+    assert os.path.isfile(inv_gif) and os.path.isfile(edit_gif)
+    events = read_ledger(ledger_path)
+    kinds = {e["event"] for e in events}
+    assert {"run_start", "compile", "phase", "telemetry", "memory",
+            "run_end"} <= kinds
+    null_tel = [e for e in events if e["event"] == "telemetry"
+                and e["program"] == "null_text_fused"]
+    assert null_tel, "fused null-text telemetry missing from the ledger"
+    rec = null_tel[0]
+    assert len(rec["loss_curve"]) == 50
+    assert len(rec["inner_steps"]) == 50
+    assert rec["inner_steps_total"] >= 50  # ≥1 inner Adam step per outer
+    assert rec["latent"]["nan_total"] == 0
+    phases = [e["name"] for e in events if e["event"] == "phase"]
+    assert "null_text_optimization" in phases
+    mod = _load_summary_tool()
+    text = mod.render(events)
+    assert "null_text_fused" in text and "inner steps" in text

@@ -1,0 +1,152 @@
+"""Render a run-ledger JSONL (videop2p_tpu/obs/ledger.py) as tables.
+
+Usage:  python tools/ledger_summary.py <ledger.jsonl>
+
+Prints the run header (run_id / git sha / jax / backend), a per-phase
+wall-clock table, a per-program compile-vs-execute table (compile events
+attributed by program label, program_call dispatch times with cache
+hit/miss counts), telemetry summaries with a loss-curve sparkline for the
+fused null-text program, training-metric and memory-snapshot digests.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from collections import defaultdict
+from typing import Dict, List
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from videop2p_tpu.obs.ledger import read_ledger  # noqa: E402
+from videop2p_tpu.obs.telemetry import sparkline  # noqa: E402
+
+
+def _table(rows: List[List[str]], header: List[str]) -> str:
+    widths = [max(len(str(r[i])) for r in [header] + rows)
+              for i in range(len(header))]
+    lines = ["  ".join(str(h).ljust(w) for h, w in zip(header, widths)),
+             "  ".join("-" * w for w in widths)]
+    lines += ["  ".join(str(c).ljust(w) for c, w in zip(r, widths))
+              for r in rows]
+    return "\n".join(lines)
+
+
+def render(events: List[Dict]) -> str:
+    """The full summary as one string (pure — tests feed synthetic events)."""
+    out: List[str] = []
+    start = next((e for e in events if e.get("event") == "run_start"), {})
+    out.append(
+        f"run {start.get('run_id', '?')}  "
+        f"sha={start.get('git_sha', '?')}  jax={start.get('jax_version', '?')}  "
+        f"backend={start.get('backend', '?')}×{start.get('device_count', '?')}  "
+        f"mesh={start.get('mesh')}  at={start.get('wall_time', '?')}"
+    )
+
+    phases: Dict[str, List[float]] = defaultdict(list)
+    for e in events:
+        if e.get("event") == "phase":
+            phases[e.get("name", "?")].append(float(e.get("seconds", 0.0)))
+    if phases:
+        rows = [[name, len(ts), f"{sum(ts):.2f}", f"{ts[-1]:.2f}"]
+                for name, ts in phases.items()]
+        rows.sort(key=lambda r: -float(r[2]))
+        out += ["", "phases:", _table(rows, ["phase", "calls", "total_s", "last_s"])]
+
+    compiles: Dict[str, List[float]] = defaultdict(list)
+    calls: Dict[str, Dict[str, float]] = defaultdict(
+        lambda: {"n": 0, "miss": 0, "dispatch_s": 0.0}
+    )
+    for e in events:
+        if e.get("event") == "compile":
+            compiles[e.get("program") or "(unattributed)"].append(
+                float(e.get("seconds", 0.0))
+            )
+        elif e.get("event") == "program_call":
+            c = calls[e.get("program") or "(unattributed)"]
+            c["n"] += 1
+            c["miss"] += 1 if e.get("cache_miss") else 0
+            c["dispatch_s"] += float(e.get("dispatch_s", 0.0))
+    if compiles or calls:
+        rows = []
+        for prog in sorted(set(compiles) | set(calls)):
+            cs, c = compiles.get(prog, []), calls.get(prog)
+            rows.append([
+                prog, len(cs), f"{sum(cs):.2f}",
+                int(c["n"]) if c else 0,
+                int(c["miss"]) if c else 0,
+                f"{c['dispatch_s']:.2f}" if c else "-",
+            ])
+        out += ["", "programs (compile vs execute):",
+                _table(rows, ["program", "compiles", "compile_s",
+                              "calls", "misses", "execute_s"])]
+
+    tel_lines: List[str] = []
+    for e in events:
+        if e.get("event") != "telemetry":
+            continue
+        prog = e.get("program", "?")
+        if e.get("loss_curve"):
+            tel_lines.append(
+                f"  {prog}: loss {sparkline(e['loss_curve'])} "
+                f"(final {e.get('loss_final')}), inner steps "
+                f"{e.get('inner_steps_total')} total"
+            )
+        summary = e.get("summary") or e.get("latent")
+        if summary:
+            nan = summary.get("nan_total", 0)
+            tel_lines.append(
+                f"  {prog}: abs_max peak {summary.get('abs_max_peak')} / "
+                f"final {summary.get('abs_max_final')}, NaN {nan}"
+                + (f" (FIRST AT STEP {summary.get('first_nan_step')})"
+                   if nan else "")
+            )
+        if e.get("telemetry_overhead_pct") is not None:
+            tel_lines.append(
+                f"  {prog}: telemetry overhead "
+                f"{e['telemetry_overhead_pct']}% "
+                f"({e.get('telemetry_off_s')}s off → "
+                f"{e.get('telemetry_on_s')}s on)"
+            )
+    if tel_lines:
+        out += ["", "telemetry:"] + tel_lines
+
+    metric_events = [e for e in events if e.get("event") == "metric"]
+    if metric_events:
+        last = metric_events[-1]
+        curve = [e["train_loss"] for e in metric_events if "train_loss" in e]
+        line = (f"  {len(metric_events)} steps logged, final "
+                + ", ".join(f"{k}={v}" for k, v in last.items()
+                            if k not in ("event", "t")))
+        out += ["", "train metrics:", line]
+        if curve:
+            out.append(f"  loss {sparkline(curve)}")
+
+    mems = [e for e in events if e.get("event") == "memory" and e.get("supported")]
+    if mems:
+        peak = max(
+            (d.get("peak_bytes_in_use") or 0)
+            for e in mems for d in e.get("devices", [])
+        )
+        out += ["", f"memory: {len(mems)} snapshots, peak "
+                f"{peak / 2**30:.2f} GiB in use"]
+
+    end = next((e for e in events if e.get("event") == "run_end"), None)
+    if end is not None:
+        out += ["", f"run ended at t={end.get('t')}s "
+                f"({end.get('compile_events', 0)} compile events)"]
+    return "\n".join(out)
+
+
+def main(argv: List[str]) -> int:
+    if len(argv) != 2:
+        print(__doc__.strip(), file=sys.stderr)
+        return 2
+    print(render(read_ledger(argv[1])))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv))

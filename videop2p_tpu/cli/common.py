@@ -22,6 +22,7 @@ __all__ = [
     "load_config",
     "add_dependent_args",
     "add_null_text_args",
+    "add_obs_args",
     "dependent_suffix",
     "resolve_pipeline_dir",
     "build_models",
@@ -142,6 +143,24 @@ def add_null_text_args(parser: argparse.ArgumentParser) -> None:
              "program with the trajectory buffer donated; N>0: split the "
              "outer scan into N-step host-dispatched chunks (the TPU "
              "execution-watchdog fallback for multi-minute fp32 programs)",
+    )
+
+
+def add_obs_args(parser: argparse.ArgumentParser) -> None:
+    """Observability knobs shared by both CLIs (videop2p_tpu/obs)."""
+    parser.add_argument(
+        "--telemetry", action="store_true",
+        help="thread fixed-shape per-step telemetry (loss curves, "
+             "inner-steps-taken, latent abs-max/NaN counts) through the "
+             "fused device programs — zero extra dispatches; decoded "
+             "host-side into the run ledger",
+    )
+    parser.add_argument(
+        "--ledger", type=str, default=None,
+        help="write a JSONL run ledger (phases, XLA compile events, "
+             "telemetry summaries, memory snapshots) to this path; "
+             "default when --telemetry is set: <output dir>/run_ledger.jsonl. "
+             "Render with tools/ledger_summary.py",
     )
 
 

@@ -24,6 +24,7 @@ import numpy as np
 
 from videop2p_tpu.cli.common import (
     add_dependent_args,
+    add_obs_args,
     build_models,
     dependent_suffix,
     encode_prompts,
@@ -31,6 +32,7 @@ from videop2p_tpu.cli.common import (
     setup_mesh,
     enable_compile_cache,
 )
+from videop2p_tpu.obs import instrumented_jit
 from videop2p_tpu.core import DDIMScheduler, DDPMScheduler, DependentNoiseSampler
 from videop2p_tpu.data import SingleVideoDataset
 from videop2p_tpu.models import decode_video, encode_video
@@ -94,6 +96,10 @@ def main(
     # device floor and K=100 amortizes to ~400 ms; a 100-step call is ~40 s,
     # inside the execution watchdog that kills multi-minute programs)
     steps_per_call: int = 100,
+    # observability (videop2p_tpu/obs): per-step loss + grad-norm telemetry
+    # riding the train scan + a JSONL run ledger
+    telemetry: bool = False,
+    ledger: Optional[str] = None,
     **unused,
 ) -> str:
     del unused
@@ -109,6 +115,19 @@ def main(
         json.dump({k: v for k, v in locals().items()
                    if isinstance(v, (str, int, float, bool, dict, list, tuple, type(None)))},
                   f, indent=2, default=str)
+
+    # unified run record (videop2p_tpu/obs): phases, compile events, train
+    # metrics and telemetry land in one JSONL stream, line-flushed
+    run_ledger = None
+    if telemetry or ledger:
+        from videop2p_tpu.obs import RunLedger
+
+        run_ledger = RunLedger(
+            ledger or os.path.join(output_dir, "run_ledger.jsonl"),
+            mesh=mesh,
+            meta={"cli": "run_tuning", "max_train_steps": max_train_steps,
+                  "telemetry": bool(telemetry)},
+        ).activate()
 
     sampler = None
     if dependent:
@@ -189,30 +208,38 @@ def main(
     # the state (params + Adam moments) is donated: the carry tree would
     # otherwise be held twice (in + out) inside the program and copied —
     # nothing else reads bundle.unet_params after TrainState.create above
-    steps_fn = jax.jit(
+    steps_fn = instrumented_jit(
         lambda s, k, n: train_steps(
             unet_fn, tx, s, noise_sched, latents, text_emb, k, num_steps=n,
-            dependent_sampler=sampler,
+            dependent_sampler=sampler, telemetry=telemetry,
         ),
+        program="train_steps",
         static_argnums=2,
         donate_argnums=(0,),
     )
 
     # per-step train_loss/lr tracker (the reference's accelerator.log /
-    # TensorBoard trackers, run_tuning.py:234,337,377-378)
+    # TensorBoard trackers, run_tuning.py:234,337,377-378); with an active
+    # ledger every logged step also becomes a ledger `metric` event
     lr_schedule = make_lr_schedule(tune_cfg)
-    metrics = MetricsLogger(output_dir)
+    metrics = MetricsLogger(output_dir, ledger=run_ledger)
     losses = []
+    grad_norms = []  # telemetry mode only: per-step pre-clip global norm
 
     def flush_losses(next_step):
         # one sync for the whole buffer (per-step float() would serialize
         # host dispatch against device compute)
         flat = np.asarray(jax.block_until_ready(jnp.concatenate(losses)))
+        gflat = (np.asarray(jax.block_until_ready(jnp.concatenate(grad_norms)))
+                 if grad_norms else None)
         start = next_step - len(flat)
         for j, lv in enumerate(flat):
-            metrics.log(start + j + 1, {"train_loss": float(lv),
-                                        "lr": float(lr_schedule(start + j))})
+            rec = {"train_loss": float(lv), "lr": float(lr_schedule(start + j))}
+            if gflat is not None:
+                rec["grad_norm"] = float(gflat[j])
+            metrics.log(start + j + 1, rec)
         losses.clear()
+        grad_norms.clear()
         return float(flat[-1])
 
     # chunks align with the periodic boundaries so per-step losses,
@@ -238,7 +265,7 @@ def main(
                 "keep the full chunk"
             )
             steps_per_call = aligned
-    t0 = time.time()
+    t0 = time.perf_counter()
     # per-step noise keys derive from (this run key, absolute step) inside
     # train_steps — logging/checkpoint cadence and resume points cannot
     # change the training noise sequence
@@ -249,13 +276,18 @@ def main(
             [max_train_steps, i + steps_per_call]
             + [(i // p + 1) * p for p in cadences]
         )
-        state, chunk_losses = steps_fn(state, train_key, nxt - i)
+        out = steps_fn(state, train_key, nxt - i)
+        if telemetry:
+            state, chunk_losses, chunk_gnorms = out
+            grad_norms.append(chunk_gnorms)
+        else:
+            state, chunk_losses = out
         losses.append(chunk_losses)  # device-side; no per-chunk host sync
         first_chunk = i == first_step
         i = nxt
         if (log_every and i % log_every == 0) or i == max_train_steps or first_chunk:
             loss = flush_losses(i)
-            rate = (i - first_step) / max(time.time() - t0, 1e-9)
+            rate = (i - first_step) / max(time.perf_counter() - t0, 1e-9)
             print(f"[tune] step {i}/{max_train_steps} loss={loss:.4f} "
                   f"({rate:.2f} it/s)")
         if checkpointing_steps and i % checkpointing_steps == 0:
@@ -269,6 +301,8 @@ def main(
     if losses:  # flush the tail of the buffer
         flush_losses(max_train_steps)
     metrics.close()
+    if run_ledger is not None:
+        run_ledger.memory_snapshot(note="after_training")
 
     save_pipeline(
         output_dir,
@@ -286,6 +320,10 @@ def main(
         },
     )
     print(f"[tune] saved pipeline to {output_dir}")
+    if run_ledger is not None:
+        run_ledger.event("artifacts", pipeline_dir=output_dir)
+        run_ledger.close()
+        print(f"[tune] run ledger: {run_ledger.path}")
     return output_dir
 
 
@@ -350,6 +388,7 @@ if __name__ == "__main__":
     parser.add_argument("--mesh", type=str, default=None,
                         help="device mesh 1,sp,tp (frames/tensor sharding)")
     add_dependent_args(parser)
+    add_obs_args(parser)
     args = parser.parse_args()
     # multi-host: join the process group before any device use (no-op on a
     # single host; see parallel/distributed.py)
@@ -370,4 +409,6 @@ if __name__ == "__main__":
         eta=args.eta,
         dependent_weights=args.dependent_weights,
         tiny=args.tiny,
+        telemetry=args.telemetry,
+        ledger=args.ledger,
     )

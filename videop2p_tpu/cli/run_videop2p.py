@@ -25,6 +25,7 @@ import numpy as np
 from videop2p_tpu.cli.common import (
     add_dependent_args,
     add_null_text_args,
+    add_obs_args,
     build_models,
     encode_prompts,
     load_config,
@@ -34,6 +35,7 @@ from videop2p_tpu.cli.common import (
 )
 from videop2p_tpu.control import make_controller
 from videop2p_tpu.core import DependentNoiseSampler
+from videop2p_tpu.obs import instrumented_jit, program_label
 from videop2p_tpu.data import load_frame_sequence
 from videop2p_tpu.models import decode_video, encode_video
 from videop2p_tpu.pipelines import (
@@ -104,6 +106,10 @@ def main(
     # of the same clip skips DDIM inversion and null-text entirely (the
     # reference's commented-out intent, run_videop2p.py:663-673)
     reuse_inversion: bool = True,
+    # observability (videop2p_tpu/obs): in-program telemetry riding the
+    # fused scans + a JSONL run ledger (phases, compile events, memory)
+    telemetry: bool = False,
+    ledger: Optional[str] = None,
     **unused,
 ) -> Tuple[str, str]:
     """Returns the (inversion_gif, edit_gif) paths it wrote."""
@@ -128,6 +134,21 @@ def main(
     inversion_gif = os.path.join(output_folder, f"inversion{suffix}.gif")
     edit_gif = os.path.join(output_folder, f"{save_name}{suffix}.gif")
     os.makedirs(output_folder, exist_ok=True)
+
+    # unified run record: every phase_timer region, XLA compile, decoded
+    # telemetry summary and memory snapshot below lands in ONE JSONL stream
+    # (events are line-flushed, so a killed run keeps what it measured)
+    run_ledger = None
+    if telemetry or ledger:
+        from videop2p_tpu import obs
+
+        run_ledger = obs.RunLedger(
+            ledger or os.path.join(output_folder, "run_ledger.jsonl"),
+            mesh=mesh,
+            meta={"cli": "run_videop2p", "fast": fast, "save_name": save_name,
+                  "prompt": prompt, "telemetry": bool(telemetry),
+                  "null_text_precision": null_text_precision},
+        ).activate()
 
     sampler = None
     if dependent_p2p or (dependent and eta > 0):
@@ -173,10 +194,11 @@ def main(
     with phase_timer("vae_encode"):
         # posterior mean, not a sample — inversion fidelity
         # (image2latent_video, run_videop2p.py:530-537); one jitted dispatch
-        latents = jax.jit(
+        latents = instrumented_jit(
             lambda vp, vid, k: encode_video(
                 bundle.vae, vp, vid.astype(dtype), k, sample=False
-            ).astype(jnp.float32)
+            ).astype(jnp.float32),
+            program="vae_encode",
         )(bundle.vae_params, video, key)
         latents = jax.block_until_ready(latents)
     if device_mesh is not None:
@@ -332,13 +354,14 @@ def main(
         from videop2p_tpu.pipelines import cached_fast_edit
 
         print("Start Video-P2P!")
-        t0 = time.time()
+        t0 = time.perf_counter()
         with phase_timer("cached_invert_edit"):
             # capture-inversion + controlled edit + VAE decode, one program:
             # the chunked decode alone is 4 host dispatches when run eagerly,
-            # each riding the tunnel
+            # each riding the tunnel; telemetry rides the SAME program's
+            # scan outputs (scalars per step — bytes of extra output)
             def fused_to_video(p, vp, x, k):
-                traj, edited = cached_fast_edit(
+                res = cached_fast_edit(
                     unet_fn, p, sched, x, cond_src, cond_all, uncond, ctx,
                     num_inference_steps=NUM_DDIM_STEPS,
                     guidance_scale=GUIDANCE_SCALE,
@@ -347,15 +370,28 @@ def main(
                     dependent_sampler=sampler if dep_w > 0 else None,
                     key=k,
                     temporal_maps_dtype=tm_dtype,
+                    telemetry=telemetry,
                 )
+                traj, edited = res[0], res[1]
                 vids = decode_video(bundle.vae, vp, edited.astype(dtype), sequential=True)
-                return traj, (vids.astype(jnp.float32) + 1) / 2
+                out = (traj, (vids.astype(jnp.float32) + 1) / 2)
+                return out + (res[2],) if telemetry else out
 
-            traj, videos = jax.jit(fused_to_video)(
+            res = instrumented_jit(fused_to_video, program="cached_invert_edit")(
                 params, bundle.vae_params, latents, ik
             )
+            traj, videos = res[0], res[1]
             videos = np.asarray(jax.device_get(videos))
-        print(f"[p2p] cached invert+edit+decode done in {time.time() - t0:.1f}s")
+            if telemetry and run_ledger is not None:
+                from videop2p_tpu.obs import decode_step_stats, summarize_step_stats
+
+                run_ledger.telemetry(
+                    "cached_invert_edit",
+                    {"summary": summarize_step_stats(res[2]),
+                     "steps": decode_step_stats(res[2])},
+                )
+        print(f"[p2p] cached invert+edit+decode done in "
+              f"{time.perf_counter() - t0:.1f}s")
         if reuse_inversion:
             save_inversion(
                 output_folder, inv_key, np.asarray(traj),
@@ -374,14 +410,15 @@ def main(
             null_embeddings = jnp.asarray(null_np)
     else:
         with phase_timer("ddim_inversion"):
-            traj = jax.jit(
+            traj = instrumented_jit(
                 lambda p, x, k: ddim_inversion(
                     unet_fn, p, sched, x, cond_src,
                     num_inference_steps=NUM_DDIM_STEPS,
                     dependent_weight=dep_w,
                     dependent_sampler=sampler if dep_w > 0 else None,
                     key=k,
-                )
+                ),
+                program="ddim_inversion",
             )(params, latents, ik)
             x_t = jax.block_until_ready(traj[-1])
         if reuse_inversion:
@@ -423,27 +460,49 @@ def main(
         )
         with phase_timer("null_text_optimization",
                          count=NUM_DDIM_STEPS * num_inner_steps,
-                         unit="inner-step"):
+                         unit="inner-step"), \
+             program_label("null_text_fused" if null_text_chunk == 0
+                           else "null_text_chunked"):
+            # program_label: the fused program jits inside its own cache, so
+            # compile events are attributed here rather than per-jit-wrapper
             if null_text_chunk > 0:
                 # watchdog fallback: short host-dispatched chunks
                 null_embeddings = null_text_optimization(
                     null_fn, params, sched, traj, cond_src, uncond[None],
-                    outer_chunk=null_text_chunk, **null_kwargs,
+                    outer_chunk=null_text_chunk, telemetry=telemetry,
+                    **null_kwargs,
                 )
+                if telemetry:
+                    null_embeddings, null_tel = null_embeddings
+                    null_stats = {"latent_stats": null_tel}
             else:
                 # ONE jitted program, trajectory buffer donated (x_t was
                 # extracted and the trajectory persisted above — nothing
                 # reads it after this point)
                 null_embeddings, null_stats = null_text_optimization_fused(
                     null_fn, params, sched, traj, cond_src, uncond[None],
-                    donate=True, return_stats=True, **null_kwargs,
+                    donate=True, return_stats=True, telemetry=telemetry,
+                    **null_kwargs,
                 )
             null_embeddings = jax.block_until_ready(null_embeddings)
-        if null_stats is not None:
+        if null_stats is not None and "inner_steps" in null_stats:
             inner_total = int(np.asarray(null_stats["inner_steps"]).sum())
             print(f"[p2p] null-text ({null_text_precision}): {inner_total} "
                   f"inner Adam steps across {NUM_DDIM_STEPS} outer steps, "
                   f"final loss {float(np.asarray(null_stats['final_loss'])[-1]):.3e}")
+        if run_ledger is not None and null_stats is not None:
+            from videop2p_tpu.obs import decode_null_text_stats, summarize_step_stats
+
+            if "inner_steps" in null_stats:
+                run_ledger.telemetry(
+                    "null_text_fused", decode_null_text_stats(null_stats)
+                )
+            elif null_stats.get("latent_stats") is not None:
+                run_ledger.telemetry(
+                    "null_text_chunked",
+                    {"latent": summarize_step_stats(null_stats["latent_stats"])},
+                )
+            run_ledger.memory_snapshot(note="after_null_text")
         if reuse_inversion:
             # trajectory.npy was written after inversion — only the null
             # embeddings are new here
@@ -458,9 +517,9 @@ def main(
     if videos is None:
         print("Start Video-P2P!")
         key, ek = jax.random.split(key)
-        t0 = time.time()
+        t0 = time.perf_counter()
         with phase_timer("edit_sample"):
-            out = jax.jit(
+            out = instrumented_jit(
                 lambda p, x, u, k: edit_sample(
                     unet_fn, p, sched, x, cond_all, u,
                     num_inference_steps=NUM_DDIM_STEPS,
@@ -471,23 +530,38 @@ def main(
                     key=k,
                     dependent_sampler=sampler if (dependent_p2p and eta > 0) else None,
                     null_uncond_embeddings=null_embeddings,
-                )
+                    telemetry=telemetry,
+                ),
+                program="edit_sample",
             )(params, x_t, uncond, ek)
+            if telemetry:
+                out, edit_tel = out
             out = jax.block_until_ready(out)
-        print(f"[p2p] controlled denoise done in {time.time() - t0:.1f}s")
+        print(f"[p2p] controlled denoise done in {time.perf_counter() - t0:.1f}s")
+        if telemetry and run_ledger is not None:
+            from videop2p_tpu.obs import decode_step_stats, summarize_step_stats
+
+            run_ledger.telemetry(
+                "edit_sample",
+                {"summary": summarize_step_stats(edit_tel),
+                 "steps": decode_step_stats(edit_tel)},
+            )
+        if run_ledger is not None:
+            run_ledger.memory_snapshot(note="after_edit")
 
         # drop the edit executable before compiling the decode program — at
         # fp32 full scale the two do not fit the chip together
         jax.clear_caches()
         with phase_timer("vae_decode"):
             # one jitted dispatch, rescale included
-            videos = jax.jit(
+            videos = instrumented_jit(
                 lambda vp, x: (
                     decode_video(
                         bundle.vae, vp, x.astype(dtype), sequential=True
                     ).astype(jnp.float32)
                     + 1
-                ) / 2
+                ) / 2,
+                program="vae_decode",
             )(bundle.vae_params, out)
             videos = np.asarray(jax.device_get(videos))
 
@@ -496,6 +570,12 @@ def main(
     save_video_gif(videos[0], inversion_gif, fps=4)
     save_video_gif(videos[1], edit_gif, fps=4)
     print(f"[p2p] wrote {inversion_gif} and {edit_gif}")
+    if run_ledger is not None:
+        run_ledger.event("artifacts", inversion_gif=inversion_gif,
+                         edit_gif=edit_gif)
+        run_ledger.memory_snapshot(note="run_end")
+        run_ledger.close()
+        print(f"[p2p] run ledger: {run_ledger.path}")
     return inversion_gif, edit_gif
 
 
@@ -523,6 +603,7 @@ if __name__ == "__main__":
                              "MXU at full rate — ~3.5x faster end-to-end)")
     add_dependent_args(parser)
     add_null_text_args(parser)
+    add_obs_args(parser)
     args = parser.parse_args()
     # multi-host: join the process group before any device use (no-op on a
     # single host; see parallel/distributed.py)
@@ -556,4 +637,6 @@ if __name__ == "__main__":
         multi=args.multi,
         cached_source=not args.live_source,
         reuse_inversion=not args.no_reuse_inversion,
+        telemetry=args.telemetry,
+        ledger=args.ledger,
     )

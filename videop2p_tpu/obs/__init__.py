@@ -1,0 +1,48 @@
+"""Observability: in-program telemetry + the unified run ledger.
+
+Two pillars (ISSUE 2):
+
+  * :mod:`videop2p_tpu.obs.telemetry` — fixed-shape telemetry buffers that
+    ride the fused pipelines' existing ``lax.scan`` outputs (zero extra
+    dispatches), plus host-side decoders that turn the stacked device
+    arrays into structured records.
+  * :mod:`videop2p_tpu.obs.ledger` — :class:`RunLedger`, one JSONL event
+    stream per run unifying phase timings (``utils.profiling.phase_timer``
+    emits into the active ledger), XLA compile events (``jax.monitoring``
+    listener + :func:`instrumented_jit` cache-miss attribution), decoded
+    telemetry summaries, and device memory snapshots.
+
+Everything here is OFF by default: with no active ledger and
+``telemetry=False`` the fused programs are bit-identical to their
+un-instrumented forms (tests/test_obs.py pins this).
+"""
+
+from videop2p_tpu.obs.ledger import (
+    RunLedger,
+    current_ledger,
+    instrumented_jit,
+    program_label,
+    read_ledger,
+)
+from videop2p_tpu.obs.telemetry import (
+    decode_null_text_stats,
+    decode_step_stats,
+    latent_stats,
+    sparkline,
+    summarize_step_stats,
+    telemetry_overhead_record,
+)
+
+__all__ = [
+    "RunLedger",
+    "current_ledger",
+    "instrumented_jit",
+    "program_label",
+    "read_ledger",
+    "latent_stats",
+    "decode_step_stats",
+    "decode_null_text_stats",
+    "summarize_step_stats",
+    "sparkline",
+    "telemetry_overhead_record",
+]

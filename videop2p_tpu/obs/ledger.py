@@ -1,0 +1,326 @@
+"""RunLedger: one JSONL event stream per run.
+
+Unifies what previously lived in four places (phase_timer prints,
+MetricsLogger's metrics.jsonl, bench_details.json, and nothing at all for
+compiles) into a single machine-readable record of what a run compiled,
+executed, and measured:
+
+  * ``run_start`` — run_id, git sha, jax version, backend/device/mesh
+    shape, caller metadata;
+  * ``phase`` — emitted by ``utils.profiling.phase_timer`` whenever a
+    ledger is active (no caller changes needed);
+  * ``compile`` — XLA backend-compile durations via a process-wide
+    ``jax.monitoring`` listener, attributed to the program label active
+    at compile time (:func:`program_label` / :func:`instrumented_jit`);
+  * ``program_call`` — per-jitted-program cache hit/miss + dispatch
+    wall-clock from :func:`instrumented_jit`;
+  * ``telemetry`` — decoded in-program telemetry summaries
+    (:mod:`videop2p_tpu.obs.telemetry`);
+  * ``memory`` — per-device ``memory_stats()`` snapshots where the
+    backend supports them (TPU yes, CPU records ``supported: false``).
+
+Events append line-buffered, so a killed run keeps everything written so
+far. ``tools/ledger_summary.py`` renders a ledger file as a table.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import json
+import os
+import socket
+import subprocess
+import threading
+import time
+import uuid
+from typing import Any, Dict, Iterator, List, Optional
+
+import jax
+
+__all__ = [
+    "RunLedger",
+    "current_ledger",
+    "program_label",
+    "instrumented_jit",
+    "read_ledger",
+]
+
+# the active-ledger stack: CLI/bench push one ledger for the whole run;
+# nested ledgers (tests) shadow the outer one
+_ACTIVE: List["RunLedger"] = []
+_ACTIVE_LOCK = threading.Lock()
+
+# program label attributed to compile events fired while it is set
+_PROGRAM: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
+    "videop2p_obs_program", default=None
+)
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_LISTENER_INSTALLED = False
+
+
+def current_ledger() -> Optional["RunLedger"]:
+    """The innermost active ledger, or None (the default — everything in
+    this module is a no-op until a RunLedger is activated)."""
+    with _ACTIVE_LOCK:
+        return _ACTIVE[-1] if _ACTIVE else None
+
+
+@contextlib.contextmanager
+def program_label(name: str) -> Iterator[None]:
+    """Attribute compile events fired inside this block to ``name`` —
+    for programs that jit internally (the fused null-text program cache)
+    where :func:`instrumented_jit` cannot wrap the jit call itself."""
+    token = _PROGRAM.set(name)
+    try:
+        yield
+    finally:
+        _PROGRAM.reset(token)
+
+
+def _install_compile_listener() -> None:
+    """Register ONE process-wide jax.monitoring listener that forwards
+    backend-compile durations to the active ledger. jax 0.4.x has no
+    per-listener unregister, so the listener is a permanent no-op when no
+    ledger is active rather than something we add/remove per run."""
+    global _LISTENER_INSTALLED
+    if _LISTENER_INSTALLED:
+        return
+
+    def on_duration(event: str, duration: float, **kw) -> None:
+        if event != _COMPILE_EVENT:
+            return
+        led = current_ledger()
+        if led is not None:
+            led._on_compile(duration, _PROGRAM.get())
+
+    try:
+        jax.monitoring.register_event_duration_secs_listener(on_duration)
+    except Exception:  # noqa: BLE001 — observability must never break a run
+        return
+    _LISTENER_INSTALLED = True
+
+
+def _git_sha() -> Optional[str]:
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=5.0,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    return out.stdout.strip() or None if out.returncode == 0 else None
+
+
+class RunLedger:
+    """Append-only JSONL event stream for one run.
+
+    Use as a context manager (activates on enter, closes on exit) or call
+    :meth:`activate` / :meth:`close` explicitly from long CLI mains. Every
+    event carries ``t`` (seconds since run start, monotonic) and the
+    ``run_start`` event anchors it to wall-clock.
+    """
+
+    def __init__(
+        self,
+        path: str,
+        *,
+        run_id: Optional[str] = None,
+        mesh: Optional[Any] = None,
+        meta: Optional[Dict[str, Any]] = None,
+        device_info: bool = True,
+    ):
+        self.path = path
+        self.run_id = run_id or uuid.uuid4().hex[:12]
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._fh = open(path, "a", buffering=1)  # line-buffered: kill-safe
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()
+        self._closed = False
+        self._activated = False
+        self.compile_seconds: List[float] = []  # drained by bench records
+        _install_compile_listener()
+
+        start: Dict[str, Any] = {
+            "run_id": self.run_id,
+            "git_sha": _git_sha(),
+            "jax_version": jax.__version__,
+            "hostname": socket.gethostname(),
+            "pid": os.getpid(),
+            "wall_time": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "mesh": (list(getattr(mesh, "shape", mesh).values())
+                     if hasattr(getattr(mesh, "shape", None), "values")
+                     else mesh if mesh is None or isinstance(mesh, (str, list))
+                     else str(mesh)),
+        }
+        if device_info:
+            # callers create the ledger after first device use, so this
+            # cannot be the call that hangs on an unhealthy backend — but
+            # guard anyway: metadata must never kill a run
+            try:
+                devs = jax.devices()
+                start["backend"] = devs[0].platform
+                start["device_count"] = len(devs)
+                start["device_kind"] = devs[0].device_kind
+            except Exception:  # noqa: BLE001
+                start["backend"] = None
+        if meta:
+            start.update(meta)
+        self.event("run_start", **start)
+
+    # ---- event writing ---------------------------------------------------
+
+    def event(self, kind: str, **fields: Any) -> None:
+        """Append one event; never raises (a full disk or closed handle
+        must not take the run down with it)."""
+        rec = {"event": kind, "t": round(time.perf_counter() - self._t0, 4)}
+        rec.update(fields)
+        try:
+            line = json.dumps(rec, default=str)
+        except (TypeError, ValueError):
+            line = json.dumps({"event": "encode_error", "kind": kind})
+        with self._lock:
+            if self._closed:
+                return
+            try:
+                self._fh.write(line + "\n")
+            except (OSError, ValueError):
+                pass
+
+    def phase(self, name: str, seconds: float, **fields: Any) -> None:
+        self.event("phase", name=name, seconds=round(float(seconds), 4), **fields)
+
+    def telemetry(self, program: str, record: Dict[str, Any]) -> None:
+        self.event("telemetry", program=program, **record)
+
+    def _on_compile(self, seconds: float, program: Optional[str]) -> None:
+        self.compile_seconds.append(float(seconds))
+        self.event("compile", seconds=round(float(seconds), 4),
+                   program=program, metric="backend_compile")
+
+    def memory_snapshot(self, note: Optional[str] = None) -> None:
+        """Per-device memory_stats + live-buffer census, where the backend
+        supports them (CPU reports supported: false rather than nothing —
+        the schema stays stable across backends)."""
+        devices = []
+        try:
+            for d in jax.local_devices():
+                try:
+                    ms = d.memory_stats()
+                except Exception:  # noqa: BLE001
+                    ms = None
+                if ms:
+                    devices.append({
+                        "device": d.id,
+                        "bytes_in_use": ms.get("bytes_in_use"),
+                        "peak_bytes_in_use": ms.get("peak_bytes_in_use"),
+                        "bytes_limit": ms.get("bytes_limit"),
+                    })
+        except Exception:  # noqa: BLE001
+            pass
+        live = None
+        try:
+            arrs = jax.live_arrays()
+            live = {"count": len(arrs),
+                    "bytes": int(sum(a.nbytes for a in arrs))}
+        except Exception:  # noqa: BLE001
+            pass
+        self.event("memory", note=note, supported=bool(devices),
+                   devices=devices, live_arrays=live)
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def activate(self) -> "RunLedger":
+        """Push onto the active stack so phase_timer / the compile listener
+        / instrumented_jit find this ledger."""
+        with _ACTIVE_LOCK:
+            if not self._activated:
+                _ACTIVE.append(self)
+                self._activated = True
+        return self
+
+    def close(self) -> None:
+        with _ACTIVE_LOCK:
+            if self in _ACTIVE:
+                _ACTIVE.remove(self)
+            self._activated = False
+        with self._lock:
+            if self._closed:
+                return
+        self.event("run_end", compile_events=len(self.compile_seconds))
+        with self._lock:
+            self._closed = True
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+
+    def __enter__(self) -> "RunLedger":
+        return self.activate()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):  # best-effort: events were line-flushed already
+        try:
+            if not self._closed:
+                self.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def instrumented_jit(fun, *, program: str, **jit_kwargs):
+    """``jax.jit`` plus ledger instrumentation.
+
+    Each call through the wrapper records a ``program_call`` event with the
+    program label, whether the call MISSED the jit cache (compiled), and
+    the dispatch wall-clock; compile events fired inside the call are
+    attributed to the label. With no active ledger the wrapper adds one
+    attribute lookup and nothing else — the jitted callable is returned
+    straight through.
+    """
+    jitted = jax.jit(fun, **jit_kwargs)
+
+    def wrapper(*args, **kwargs):
+        led = current_ledger()
+        if led is None:
+            return jitted(*args, **kwargs)
+        try:
+            before = jitted._cache_size()
+        except Exception:  # noqa: BLE001 — private API; degrade gracefully
+            before = None
+        t0 = time.perf_counter()
+        with program_label(program):
+            out = jitted(*args, **kwargs)
+        dt = time.perf_counter() - t0
+        miss = None
+        if before is not None:
+            try:
+                miss = jitted._cache_size() > before
+            except Exception:  # noqa: BLE001
+                miss = None
+        led.event("program_call", program=program, cache_miss=miss,
+                  dispatch_s=round(dt, 4))
+        return out
+
+    wrapper._jitted = jitted  # escape hatch (lower/compile introspection)
+    wrapper.__name__ = f"instrumented[{program}]"
+    return wrapper
+
+
+def read_ledger(path: str) -> List[Dict[str, Any]]:
+    """Parse a ledger JSONL file back into event dicts (skips any torn
+    final line from a killed run)."""
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except ValueError:
+                continue
+    return events

@@ -131,11 +131,15 @@ def cached_fast_edit(
     dependent_sampler: Optional[DependentNoiseSampler] = None,
     key: Optional[jax.Array] = None,
     temporal_maps_dtype=None,
+    telemetry: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """Capture-inversion of ``latents`` under ``cond_src`` followed by the
     cached-source controlled edit under ``cond_all``/``uncond``. Returns
     ``(trajectory, edited_latents)`` — the trajectory for persistence, the
-    (P, F, h, w, C) output with stream 0 the exact reconstruction."""
+    (P, F, h, w, C) output with stream 0 the exact reconstruction.
+    ``telemetry=True`` returns ``(trajectory, edited, tel)`` with the edit
+    scan's per-step telemetry (sampling.edit_sample) riding the same fused
+    program; off by default, leaving the program byte-identical."""
     trajectory, cached = ddim_inversion_captured(
         unet_fn, params, scheduler, latents, cond_src,
         num_inference_steps=num_inference_steps,
@@ -154,5 +158,9 @@ def cached_fast_edit(
         ctx=ctx,
         source_uses_cfg=False,
         cached_source=cached,
+        telemetry=telemetry,
     )
+    if telemetry:
+        edited, tel = edited
+        return trajectory, edited, tel
     return trajectory, edited

@@ -41,6 +41,7 @@ import optax
 from videop2p_tpu.core.ddim import DDIMScheduler
 from videop2p_tpu.core.noise import DependentNoiseSampler
 from videop2p_tpu.models.attention import AttnControl
+from videop2p_tpu.obs.telemetry import latent_stats
 from videop2p_tpu.pipelines.cached import CachedSource, filter_site_tree
 from videop2p_tpu.pipelines.sampling import UNetFn
 from videop2p_tpu.pipelines.stores import blend_maps_from_store
@@ -301,6 +302,7 @@ def null_text_optimization(
     early_stop: bool = True,
     return_losses: bool = False,
     return_inner_steps: bool = False,
+    telemetry: bool = False,
 ) -> jax.Array:
     """Optimize a per-step unconditional embedding that makes CFG denoising
     replay the recorded inversion trajectory (run_videop2p.py:580-612).
@@ -341,6 +343,14 @@ def null_text_optimization(
     ``return_inner_steps``: also return the number of inner Adam updates
     each outer step actually took (num_steps,) int32 — the early-stop
     observability the fused-vs-host parity test pins.
+
+    ``telemetry``: additionally stack per-outer-step latent statistics
+    (obs.telemetry.latent_stats of the advanced ``latent_cur`` — abs-max,
+    mean, NaN/inf counts) as a fourth output. The stats ride the outer
+    scan's ``ys`` — zero extra dispatches — and are scalars per step, so
+    the program output grows by bytes. Off by default: the telemetry-off
+    program is the exact pre-telemetry program (tests/test_obs.py pins
+    bit-exactness).
 
     ``outer_chunk``: split the outer scan into host-level jitted chunks of
     this many steps (one compile, several executions). At SD scale the full
@@ -438,9 +448,12 @@ def null_text_optimization(
         eps_c = blend(eps_cond_raw, k_fc)
         eps = eps_uncond + guidance_scale * (eps_c - eps_uncond)
         latent_cur = scheduler.prev_step(eps, t, latent_cur, num_inference_steps)
-        return (latent_cur, uncond, key, params, cond_embedding), (
-            uncond, final_loss, inner_taken,
-        )
+        ys = (uncond, final_loss, inner_taken)
+        if telemetry:
+            # scalar stats ride the scan output — no extra dispatch, and
+            # a fused-scan NaN becomes visible with the step it appeared at
+            ys += (latent_stats(latent_cur),)
+        return (latent_cur, uncond, key, params, cond_embedding), ys
 
     x_t = trajectory[-1]
     xs = (timesteps, prev_seq, lr_seq, thresh_seq)
@@ -456,19 +469,21 @@ def null_text_optimization(
 
         return body
 
-    def pack(uncond_seq, losses, inner_taken):
+    def pack(uncond_seq, losses, inner_taken, tel=None):
         out = (uncond_seq,)
         if return_losses:
             out += (losses,)
         if return_inner_steps:
             out += (inner_taken,)
+        if telemetry:
+            out += (tel,)
         return out if len(out) > 1 else out[0]
 
     if not outer_chunk or outer_chunk >= num_inference_steps:
-        _, (uncond_seq, losses, inner_taken) = jax.lax.scan(
+        _, ys = jax.lax.scan(
             make_body(params, cond_embedding), (x_t, uncond_embedding, key), xs
         )
-        return pack(uncond_seq, losses, inner_taken)
+        return pack(*ys)
 
     # chunked path: params/cond enter as plain jit inputs (same no-carry rule
     # as above), and the jitted chunk scan is cached on the statics its
@@ -476,7 +491,7 @@ def null_text_optimization(
     cache_key = (
         unet_fn, id(scheduler), id(dependent_sampler), float(guidance_scale),
         int(num_inner_steps), int(num_inference_steps), float(dependent_weight),
-        bool(early_stop), null_text_precision,
+        bool(early_stop), null_text_precision, bool(telemetry),
     )
     chunk_scan = _CHUNK_SCAN_CACHE.get(cache_key)
     if chunk_scan is None:
@@ -487,18 +502,18 @@ def null_text_optimization(
         chunk_scan = jax.jit(chunk_fn)
         _cache_put(_CHUNK_SCAN_CACHE, _CHUNK_SCAN_CACHE_MAX, cache_key, chunk_scan)
     small = (x_t, uncond_embedding, key)
-    pieces, loss_pieces, step_pieces = [], [], []
+    piece_lists = None
     for start in range(0, num_inference_steps, outer_chunk):
         chunk = jax.tree.map(lambda a: a[start : start + outer_chunk], xs)
-        small, (seq, losses, taken) = chunk_scan(params, cond_embedding, small, chunk)
-        pieces.append(seq)
-        loss_pieces.append(losses)
-        step_pieces.append(taken)
-    return pack(
-        jnp.concatenate(pieces, axis=0),
-        jnp.concatenate(loss_pieces, axis=0),
-        jnp.concatenate(step_pieces, axis=0),
-    )
+        small, ys = chunk_scan(params, cond_embedding, small, chunk)
+        if piece_lists is None:
+            piece_lists = [[] for _ in ys]
+        for lst, y in zip(piece_lists, ys):
+            lst.append(y)
+    return pack(*(
+        jax.tree.map(lambda *xs_: jnp.concatenate(xs_, axis=0), *lst)
+        for lst in piece_lists
+    ))
 
 
 def null_text_optimization_fused(
@@ -520,6 +535,7 @@ def null_text_optimization_fused(
     early_stop: bool = True,
     donate: bool = True,
     return_stats: bool = False,
+    telemetry: bool = False,
 ):
     """Null-text optimization as ONE jitted, donated-carry device program.
 
@@ -550,7 +566,11 @@ def null_text_optimization_fused(
     ``return_stats=True`` returns ``(uncond_seq, stats)`` where ``stats`` is
     ``{"final_loss": (num_steps,) float32, "inner_steps": (num_steps,)
     int32}`` — the reconstruction objective per outer step and the inner
-    Adam updates its early stop actually took.
+    Adam updates its early stop actually took. ``telemetry=True``
+    (requires ``return_stats``) adds ``stats["latent_stats"]`` — per-outer-
+    step latent abs-max/mean/NaN/inf scalars stacked inside the SAME fused
+    program (obs.telemetry; zero extra dispatches, off by default so the
+    donated fast path is untouched).
     """
     if null_text_precision not in _NULL_TEXT_PRECISIONS:
         raise ValueError(
@@ -559,6 +579,12 @@ def null_text_optimization_fused(
         )
     if dependent_weight > 0.0 and dependent_sampler is None:
         raise ValueError("dependent_weight > 0 requires dependent_sampler")
+    if telemetry and not return_stats:
+        raise ValueError(
+            "telemetry=True surfaces through the stats record — pass "
+            "return_stats=True (silently computing-and-dropping telemetry "
+            "would still change the compiled program)"
+        )
     if key is None:
         key = jax.random.key(0)
     # the CPU backend cannot alias donated buffers — requesting donation
@@ -569,6 +595,7 @@ def null_text_optimization_fused(
         unet_fn, id(scheduler), id(dependent_sampler), float(guidance_scale),
         int(num_inner_steps), int(num_inference_steps), float(dependent_weight),
         float(epsilon), bool(early_stop), null_text_precision, bool(donate),
+        bool(telemetry),
     )
     program = _FUSED_PROGRAM_CACHE.get(cache_key)
     if program is None:
@@ -587,6 +614,7 @@ def null_text_optimization_fused(
                 early_stop=early_stop,
                 return_losses=True,
                 return_inner_steps=True,
+                telemetry=telemetry,
             )
 
         # argnum 2 = the trajectory, the only buffer worth donating (the
@@ -597,9 +625,11 @@ def null_text_optimization_fused(
         _cache_put(_FUSED_PROGRAM_CACHE, _FUSED_PROGRAM_CACHE_MAX,
                    cache_key, program)
 
-    uncond_seq, losses, inner_taken = program(
-        params, cond_embedding, trajectory, uncond_embedding, key
-    )
+    outs = program(params, cond_embedding, trajectory, uncond_embedding, key)
+    uncond_seq, losses, inner_taken = outs[:3]
     if return_stats:
-        return uncond_seq, {"final_loss": losses, "inner_steps": inner_taken}
+        stats = {"final_loss": losses, "inner_steps": inner_taken}
+        if telemetry:
+            stats["latent_stats"] = outs[3]
+        return uncond_seq, stats
     return uncond_seq

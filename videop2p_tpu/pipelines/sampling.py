@@ -34,6 +34,7 @@ from videop2p_tpu.control.local_blend import local_blend
 from videop2p_tpu.core.ddim import DDIMScheduler
 from videop2p_tpu.core.noise import DependentNoiseSampler
 from videop2p_tpu.models.attention import AttnControl
+from videop2p_tpu.obs.telemetry import latent_stats
 from videop2p_tpu.pipelines.cached import CachedSource
 from videop2p_tpu.pipelines.stores import blend_maps_from_store
 
@@ -46,6 +47,21 @@ UNetFn = Callable[..., Tuple[jax.Array, dict]]
 # (bounded FIFO — same discipline as inversion.py's program caches)
 _OFFICIAL_EDIT_CACHE: dict = {}
 _OFFICIAL_EDIT_CACHE_MAX = 4
+
+
+def _controller_gates(ctx: Optional[ControlContext], i) -> dict:
+    """Per-step controller edit activity, as fixed-shape scalars for the
+    telemetry stream: the mean cross-replace gate at step ``i`` (the alpha
+    that blends source maps into the edit streams) and whether the
+    self/temporal replacement window covers the step. ``i`` may be traced."""
+    if ctx is None:
+        return {"cross_gate_mean": jnp.asarray(0.0, jnp.float32),
+                "self_edit_active": jnp.asarray(0, jnp.int32)}
+    lo, hi = ctx.self_replace_range
+    return {
+        "cross_gate_mean": jnp.mean(ctx.cross_replace_alpha[i]).astype(jnp.float32),
+        "self_edit_active": jnp.logical_and(i >= lo, i < hi).astype(jnp.int32),
+    }
 
 
 def make_unet_fn(model) -> UNetFn:
@@ -84,6 +100,7 @@ def edit_sample(
     blend_res: Optional[Tuple[int, int]] = None,
     null_uncond_embeddings: Optional[jax.Array] = None,
     cached_source: Optional[CachedSource] = None,
+    telemetry: bool = False,
 ) -> jax.Array:
     """Run the controlled denoise loop; returns final latents (P, F, h, w, C).
 
@@ -109,6 +126,14 @@ def edit_sample(
     pass ``cond_embeddings`` as (P, F, L, D); ``uncond_embeddings`` stays
     (L, D) and broadcasts per frame, and ``null_uncond_embeddings`` may be
     per-frame (num_steps, F, L, D).
+
+    ``telemetry=True``: return ``(latents, tel)`` where ``tel`` stacks
+    per-DDIM-step scalars riding the scan output (zero extra dispatches —
+    obs.telemetry): post-step latent abs-max/mean + NaN/inf counts, the
+    controller's cross-edit gate mean at that step, and whether the
+    self/temporal replacement window was active. Off by default; the
+    telemetry-off program is unchanged (tests/test_obs.py pins the outputs
+    bit-exact, cached replay exactness included).
     """
     P = cond_embeddings.shape[0]
     multi = cond_embeddings.ndim == 4
@@ -168,7 +193,7 @@ def edit_sample(
             uncond_embeddings, cached_source,
             num_inference_steps=num_inference_steps,
             guidance_scale=guidance_scale, ctx=ctx,
-            blend_res=blend_res,
+            blend_res=blend_res, telemetry=telemetry,
         )
 
     # the source stream's per-step uncond: the null-text sequence when given,
@@ -300,10 +325,15 @@ def edit_sample(
             latents = jnp.where(
                 active, jnp.broadcast_to(latents[:1], latents.shape), latents
             )
-        return (latents, maps_sum, key), None
+        ys = None
+        if telemetry:
+            ys = dict(latent_stats(latents), **_controller_gates(ctx, i))
+        return (latents, maps_sum, key), ys
 
     xs = (timesteps, jnp.arange(num_inference_steps), uncond0_seq)
-    (latents, _, _), _ = jax.lax.scan(body, (latents, maps_sum, key), xs)
+    (latents, _, _), tel = jax.lax.scan(body, (latents, maps_sum, key), xs)
+    if telemetry:
+        return latents, tel
     return latents
 
 
@@ -320,6 +350,7 @@ def _edit_sample_cached(
     guidance_scale: float,
     ctx: Optional[ControlContext],
     blend_res: Optional[Tuple[int, int]],
+    telemetry: bool = False,
 ) -> jax.Array:
     """The cached-source denoise loop: only the P−1 edit streams run the
     UNet; the source stream is read off the reversed inversion trajectory
@@ -443,7 +474,12 @@ def _edit_sample_cached(
                 jnp.broadcast_to(src_after, edit_latents.shape),
                 edit_latents,
             )
-        return (edit_latents, maps_sum), None
+        ys = None
+        if telemetry:
+            # stats cover the EDIT streams only — the source stream is a
+            # replayed constant here, by construction finite and exact
+            ys = dict(latent_stats(edit_latents), **_controller_gates(ctx, i))
+        return (edit_latents, maps_sum), ys
 
     blend_xs = (
         cached.blend_seq
@@ -451,9 +487,12 @@ def _edit_sample_cached(
         else jnp.zeros((num_inference_steps, 0))
     )
     xs = (timesteps, jnp.arange(num_inference_steps), src_seq, blend_xs)
-    (edit_latents, _), _ = jax.lax.scan(body, (edit_latents, maps_sum), xs)
+    (edit_latents, _), tel = jax.lax.scan(body, (edit_latents, maps_sum), xs)
     # stream 0 = the exact inversion reconstruction (trajectory[0] = x_0)
-    return jnp.concatenate([cached.src_latents[-1], edit_latents], axis=0)
+    out = jnp.concatenate([cached.src_latents[-1], edit_latents], axis=0)
+    if telemetry:
+        return out, tel
+    return out
 
 
 def official_edit(

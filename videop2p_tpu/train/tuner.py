@@ -167,11 +167,15 @@ def train_step(
     key: jax.Array,
     *,
     dependent_sampler: Optional[DependentNoiseSampler] = None,
+    return_grad_norm: bool = False,
 ) -> Tuple[TrainState, jax.Array]:
     """One tuning step on VAE-encoded latents (run_tuning.py:280-331).
 
     ``latents``: (B, F, h, w, C) clean latents (already ×0.18215);
-    ``text_embeddings``: (B, L, D). Returns (new_state, loss).
+    ``text_embeddings``: (B, L, D). Returns (new_state, loss) — or
+    (new_state, loss, grad_norm) with ``return_grad_norm=True``: the
+    PRE-clip global gradient norm (the quantity ``max_grad_norm`` gates),
+    the standard training-health telemetry signal.
     """
     noise_key, t_key = jax.random.split(key)
     if dependent_sampler is not None:
@@ -194,15 +198,15 @@ def train_step(
     loss, grads = jax.value_and_grad(loss_fn)(state.trainable)
     updates, opt_state = tx.update(grads, state.opt_state, state.trainable)
     trainable = optax.apply_updates(state.trainable, updates)
-    return (
-        TrainState(
-            step=state.step + 1,
-            trainable=trainable,
-            frozen=state.frozen,
-            opt_state=opt_state,
-        ),
-        loss,
+    new_state = TrainState(
+        step=state.step + 1,
+        trainable=trainable,
+        frozen=state.frozen,
+        opt_state=opt_state,
     )
+    if return_grad_norm:
+        return new_state, loss, optax.global_norm(grads)
+    return new_state, loss
 
 
 def train_steps(
@@ -216,6 +220,7 @@ def train_steps(
     *,
     num_steps: int,
     dependent_sampler: Optional[DependentNoiseSampler] = None,
+    telemetry: bool = False,
 ) -> Tuple[TrainState, jax.Array]:
     """``num_steps`` tuning steps as ONE ``lax.scan`` — one device program
     instead of per-step host dispatches. On this harness each dispatch rides
@@ -235,7 +240,11 @@ def train_steps(
     on (seed, step index) — chunk boundaries (logging/checkpoint cadence,
     ``steps_per_call``) and resume points cannot change the trained model.
 
-    Returns (state, per-step losses (num_steps,)).
+    Returns (state, per-step losses (num_steps,)); with ``telemetry=True``
+    returns (state, losses, grad_norms) — the per-step PRE-clip global
+    gradient norm stacked by the same scan (zero extra dispatches; the
+    norm's reductions are already computed inside the clipping transform,
+    so the marginal device work is a handful of scalars).
     """
     frozen = state.frozen
 
@@ -243,19 +252,23 @@ def train_steps(
         step, trainable, opt_state = carry
         s = TrainState(step=step, trainable=trainable, frozen=frozen,
                        opt_state=opt_state)
-        s, loss = train_step(
+        out = train_step(
             unet_fn, tx, s, scheduler, latents, text_embeddings,
             jax.random.fold_in(key, step),
             dependent_sampler=dependent_sampler,
+            return_grad_norm=telemetry,
         )
-        return (s.step, s.trainable, s.opt_state), loss
+        s = out[0]
+        ys = (out[1], out[2]) if telemetry else out[1]
+        return (s.step, s.trainable, s.opt_state), ys
 
-    (step, trainable, opt_state), losses = jax.lax.scan(
+    (step, trainable, opt_state), ys = jax.lax.scan(
         body, (state.step, state.trainable, state.opt_state), None,
         length=num_steps,
     )
-    return (
-        TrainState(step=step, trainable=trainable, frozen=frozen,
-                   opt_state=opt_state),
-        losses,
-    )
+    state = TrainState(step=step, trainable=trainable, frozen=frozen,
+                       opt_state=opt_state)
+    if telemetry:
+        losses, grad_norms = ys
+        return state, losses, grad_norms
+    return state, ys

@@ -6,24 +6,42 @@ record for reporting — with ``count`` it also reports per-unit time (e.g.
 ms per null-text inner Adam step, the official mode's dominant unit of
 work); ``trace`` wraps ``jax.profiler`` for TensorBoard-viewable device
 traces when a trace dir is set (VIDEOP2P_TRACE_DIR env var).
+
+All timing uses ``time.perf_counter`` (monotonic): ``time.time`` is
+wall-clock and steps under NTP adjustment, which corrupted phase records.
+When a :class:`videop2p_tpu.obs.ledger.RunLedger` is active, every phase
+additionally lands in the ledger as a ``phase`` event — callers need no
+changes to get their timings into the run record.
 """
 
 from __future__ import annotations
 
 import contextlib
 import os
+import threading
 import time
 from typing import Dict, Iterator, List, Optional, Tuple
 
-__all__ = ["phase_timer", "phase_records", "last_phase_seconds", "trace"]
+__all__ = [
+    "phase_timer",
+    "phase_records",
+    "last_phase_seconds",
+    "reset",
+    "trace",
+]
 
+# guarded by _RECORDS_LOCK: phase_timer regions can close on worker threads
+# (the UI trainer, future async pipelines)
 _RECORDS: List[Tuple[str, float]] = []
+_RECORDS_LOCK = threading.Lock()
 
 
 def phase_records() -> Dict[str, float]:
-    """Total seconds per phase name, accumulated across the process."""
+    """Total seconds per phase name, accumulated since the last reset."""
     out: Dict[str, float] = {}
-    for name, dt in _RECORDS:
+    with _RECORDS_LOCK:
+        records = list(_RECORDS)
+    for name, dt in records:
         out[name] = out.get(name, 0.0) + dt
     return out
 
@@ -32,10 +50,20 @@ def last_phase_seconds(name: str) -> Optional[float]:
     """The most recent recorded duration of a named phase (None if the
     phase never ran) — lets callers derive per-unit metrics from a region
     they timed with :func:`phase_timer` without re-measuring."""
-    for rec_name, dt in reversed(_RECORDS):
+    with _RECORDS_LOCK:
+        records = list(_RECORDS)
+    for rec_name, dt in reversed(records):
         if rec_name == name:
             return dt
     return None
+
+
+def reset() -> None:
+    """Drop all accumulated phase records. Long-lived processes (bench
+    sweeps, the demo UI) call this between configurations — the record
+    list otherwise grows unboundedly and mixes configurations' timings."""
+    with _RECORDS_LOCK:
+        _RECORDS.clear()
 
 
 @contextlib.contextmanager
@@ -50,12 +78,24 @@ def phase_timer(
     the printed line (``[phase] null_text_optimization: 207.10s
     (414.2 ms/inner-step)``) — an upper bound when the region early-stops
     below ``count`` units."""
-    t0 = time.time()
+    t0 = time.perf_counter()
     try:
         yield
     finally:
-        dt = time.time() - t0
-        _RECORDS.append((name, dt))
+        dt = time.perf_counter() - t0
+        with _RECORDS_LOCK:
+            _RECORDS.append((name, dt))
+        # lazy import: utils must stay importable without obs (and obs
+        # imports nothing from here — no cycle either way)
+        try:
+            from videop2p_tpu.obs.ledger import current_ledger
+
+            led = current_ledger()
+        except Exception:  # noqa: BLE001 — observability never breaks timing
+            led = None
+        if led is not None:
+            extra = {"count": count, "unit": unit} if count else {}
+            led.phase(name, dt, **extra)
         if verbose:
             per = f" ({dt / count * 1e3:.1f} ms/{unit})" if count else ""
             print(f"[phase] {name}: {dt:.2f}s{per}")
