@@ -87,15 +87,120 @@ def test_unavailable_backend_still_emits_one_parseable_line(bench, capsys):
 
 def test_main_short_circuits_when_backend_unavailable(bench, capsys, monkeypatch):
     # main() must emit the error record and return WITHOUT touching jax —
-    # a failed init can be cached for the life of the process
+    # a failed init can be cached for the life of the process. The only
+    # extra work allowed after the error line is the CPU cost-analysis
+    # capture (subprocess-isolated, ISSUE 3 satellite) — verified invoked.
     monkeypatch.setattr(bench, "wait_for_backend", lambda **kw: False)
     monkeypatch.setattr(
         bench, "build_fast_edit_working_point",
         lambda **kw: pytest.fail("touched the device after a failed probe"),
     )
+    called = []
+    monkeypatch.setattr(bench, "record_cpu_only_evidence",
+                        lambda: called.append(True))
     bench.main()
     rec = json.loads(capsys.readouterr().out.strip())
     assert rec["error"] == "backend_unavailable"
+    assert called == [True]
+
+
+def test_cpu_only_evidence_records_analyses_and_verdicts(
+    bench, tmp_path, monkeypatch
+):
+    """Backend-down evidence path: the subprocess capture's analyses land
+    in bench_details.json with regression verdicts vs the previous
+    record — no round is evidence-free (VERDICT r5 'What's missing' #1)."""
+    details = tmp_path / "bench_details.json"
+    # a previous record to regress against: e2e temp bytes grew 50%
+    details.write_text(json.dumps({
+        "breakdown": {"program_analysis": {
+            "e2e_cached": {"flops": 1000, "temp_bytes": 100 * 2**20,
+                           "hlo_fingerprint": "aa"},
+        }},
+    }))
+    analyses = {
+        "e2e_cached": {"flops": 1000, "temp_bytes": 150 * 2**20,
+                       "hlo_fingerprint": "bb"},
+        "invert_captured": {"flops": 500, "temp_bytes": 10,
+                            "hlo_fingerprint": "cc"},
+    }
+    monkeypatch.setattr(bench, "collect_cpu_analysis",
+                        lambda *a, **kw: analyses)
+    bench.record_cpu_only_evidence(repo_dir=str(tmp_path))
+    doc = json.loads(details.read_text())
+    bd = doc["breakdown"]
+    assert bd["program_analysis"] == analyses
+    assert bd["program_analysis_backend"] == "cpu"
+    v = bd["analysis_verdicts"]
+    assert v["baseline"] == "bench_details.json"
+    assert v["compared_programs"] == ["e2e_cached"]
+    assert not v["pass"]
+    regs = {r["metric"] for r in v["regressions"]}
+    assert "temp_bytes" in regs
+    assert all(r["fingerprint_changed"] for r in v["regressions"]
+               if "fingerprint_changed" in r)
+
+
+def test_cpu_only_evidence_skippable_and_failure_tolerant(
+    bench, tmp_path, monkeypatch
+):
+    # kill-switch: no capture attempted
+    monkeypatch.setenv("VIDEOP2P_BENCH_CPU_ANALYSIS", "0")
+    monkeypatch.setattr(
+        bench, "collect_cpu_analysis",
+        lambda *a, **kw: pytest.fail("capture ran despite the kill-switch"),
+    )
+    bench.record_cpu_only_evidence(repo_dir=str(tmp_path))
+    assert not (tmp_path / "bench_details.json").exists()
+    # empty capture (timeout before any program finished): readable error
+    monkeypatch.setenv("VIDEOP2P_BENCH_CPU_ANALYSIS", "1")
+    monkeypatch.setattr(bench, "collect_cpu_analysis", lambda *a, **kw: {})
+    bench.record_cpu_only_evidence(repo_dir=str(tmp_path))
+    doc = json.loads((tmp_path / "bench_details.json").read_text())
+    assert "cpu_analysis_error" in doc["breakdown"]
+
+
+def test_collect_cpu_analysis_parses_partial_output(bench, monkeypatch):
+    """A timeout mid-capture keeps the programs whose JSON lines flushed."""
+    payload = (
+        json.dumps({"program": "invert_captured", "flops": 7}) + "\n"
+        + '{"program": "e2e_cached", "flo'  # torn final line
+    )
+
+    def fake_run(cmd, **kw):
+        raise subprocess.TimeoutExpired(cmd, kw.get("timeout"),
+                                        output=payload.encode())
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    out = bench.collect_cpu_analysis(8, 50, timeout_s=1.0)
+    assert out == {"invert_captured": {"flops": 7}}
+
+
+def test_load_analysis_baseline_precedence(bench, tmp_path):
+    # nothing on disk: no baseline
+    assert bench.load_analysis_baseline(str(tmp_path)) == (None, None)
+    # bench_details.json record is the fallback baseline
+    (tmp_path / "bench_details.json").write_text(json.dumps(
+        {"breakdown": {"program_analysis": {"p": {"flops": 1}}}}
+    ))
+    section, source = bench.load_analysis_baseline(str(tmp_path))
+    assert source == "bench_details.json" and section == {"p": {"flops": 1}}
+    # an explicit BASELINE.json budget wins over it
+    (tmp_path / "BASELINE.json").write_text(json.dumps(
+        {"program_analysis": {"p": {"flops": 2}}}
+    ))
+    section, source = bench.load_analysis_baseline(str(tmp_path))
+    assert source == "BASELINE.json" and section == {"p": {"flops": 2}}
+
+
+def test_bench_analysis_verdicts_schema(bench):
+    base = {"p": {"flops": 100, "temp_bytes": 100, "hlo_fingerprint": "x"}}
+    same = bench.bench_analysis_verdicts(base, base, "BASELINE.json")
+    assert same["pass"] and same["regressions"] == []
+    assert same["compared_programs"] == ["p"]
+    # first capture: no baseline → vacuous pass, still machine-readable
+    first = bench.bench_analysis_verdicts(base, None, None)
+    assert first["pass"] and first["baseline"] is None
 
 
 def test_sub_floor_trace_span_is_recorded_suspect_not_floor_clamped(
@@ -355,3 +460,22 @@ def test_dryrun_runs_inline_when_already_on_a_big_cpu_mesh(graft, monkeypatch):
     monkeypatch.setattr(graft, "_dryrun_impl", lambda n: ran.setdefault("n", n))
     graft.dryrun_multichip(8)
     assert ran["n"] == 8
+
+
+@pytest.mark.slow
+def test_cpu_cost_capture_tool_end_to_end_tiny(bench, tmp_path):
+    """The real subprocess path at tiny scale: the tool builds the bench
+    programs abstractly, compiles them on CPU, and emits one JSON record
+    per program plus program_analysis ledger events."""
+    ledger = str(tmp_path / "capture_ledger.jsonl")
+    out = bench.collect_cpu_analysis(2, 2, tiny=True, timeout_s=560.0,
+                                     ledger_path=ledger)
+    assert set(out) == {"invert_captured", "edit_cached", "e2e_cached"}
+    for name, rec in out.items():
+        assert rec["flops"] > 0, name
+        assert rec["peak_hbm_bytes"] > 0, name
+        assert len(rec["hlo_fingerprint"]) == 16, name
+        assert rec["backend"] == "cpu" and rec["steps"] == 2
+    events = [json.loads(l) for l in open(ledger) if l.strip()]
+    pa = {e["program"] for e in events if e["event"] == "program_analysis"}
+    assert pa == set(out)

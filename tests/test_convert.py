@@ -403,3 +403,111 @@ def test_exported_state_dict_loads_into_torch_reference(tiny_unet_params):
         np.transpose(out_torch.numpy(), (0, 2, 3, 4, 1)),
         atol=5e-4,  # f32 reduction-order noise at flax-init weight scales
     )
+
+
+# ---------------------------------------------------------------------- #
+# SD-1.5 full key-manifest coverage (ISSUE 3 satellite; VERDICT r5 #6)
+# ---------------------------------------------------------------------- #
+
+
+def _torch_manifest_entry(path, leaf_shape):
+    """(torch_key, torch-layout shape) for one flax param path — the
+    inverse of convert's import transforms, matching the real diffusers
+    layout (conv kernels OIHW, dense weights transposed, SD-1.x
+    transformer proj_in/proj_out stored as 1×1 convs)."""
+    from videop2p_tpu.models.convert import _flax_path_to_torch
+
+    torch_key, kind = _flax_path_to_torch(path)
+    if kind == "conv":
+        kh, kw, ci, co = leaf_shape
+        return torch_key, (co, ci, kh, kw)
+    if kind == "dense":
+        ci, co = leaf_shape
+        if path[-2] in ("proj_in", "proj_out") and not any(
+            t.startswith("blocks_") for t in path
+        ):
+            return torch_key, (co, ci, 1, 1)
+        return torch_key, (co, ci)
+    return torch_key, tuple(leaf_shape)
+
+
+@pytest.fixture(scope="module")
+def sd15_manifest():
+    """The FULL SD-1.5 UNet topology (UNet3DConfig.sd15()) as abstract flax
+    params plus the enumerated torch key manifest. Arrays are zero-stride
+    broadcast views — the manifest costs shape metadata, not 3.4 GB."""
+    from flax import traverse_util
+
+    cfg = UNet3DConfig.sd15()
+    model = UNet3DConditionModel(config=cfg)
+    abstract = jax.eval_shape(
+        model.init, jax.random.key(0),
+        jax.ShapeDtypeStruct((1, 2, 64, 64, 4), jnp.bfloat16),
+        jax.ShapeDtypeStruct((), jnp.int32),
+        jax.ShapeDtypeStruct((1, 77, 768), jnp.bfloat16),
+    )["params"]
+    # bf16 target leaves halve the materialized import (shapes are what the
+    # manifest tests; dtype is the caller's choice in convert)
+    abstract = jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, jnp.bfloat16), abstract
+    )
+    flat = traverse_util.flatten_dict(abstract)
+    manifest, temporal_manifest, temporal_paths = {}, {}, []
+    for path, leaf in flat.items():
+        torch_key, tshape = _torch_manifest_entry(path, tuple(leaf.shape))
+        arr = np.broadcast_to(np.zeros((), np.float32), tshape)
+        pstr = "/".join(path)
+        if "attn_temp" in pstr or "norm_temp" in pstr:
+            temporal_paths.append(pstr)
+            temporal_manifest[torch_key] = arr
+            continue
+        # bijection: no two flax params may claim the same torch key
+        assert torch_key not in manifest, torch_key
+        manifest[torch_key] = arr
+    return abstract, flat, manifest, temporal_manifest, temporal_paths
+
+
+def test_sd15_2d_manifest_fully_consumed_and_initialized(sd15_manifest):
+    """A genuine SD-1.5 2-D checkpoint manifest: every torch key consumed,
+    every flax param initialized, and EXACTLY the temporal params keep
+    their fresh init (the reference's '_temp.'-keys rule, unet.py:446-448).
+    686 keys — the diffusers SD-1.5 UNet state-dict size — pinned so a
+    mapping drift cannot silently shrink coverage."""
+    abstract, flat, manifest, _, temporal_paths = sd15_manifest
+    assert len(manifest) == 686
+    assert len(temporal_paths) == 112
+    params, report = unet3d_params_from_torch(manifest, abstract)
+    assert report["unused"] == []
+    assert sorted(report["kept_init"]) == sorted(temporal_paths)
+    # spot-pin known diffusers keys (layout included) against drift
+    assert manifest["conv_in.weight"].shape == (320, 4, 3, 3)
+    assert manifest["time_embedding.linear_1.weight"].shape == (1280, 320)
+    assert manifest[
+        "down_blocks.0.attentions.0.transformer_blocks.0.attn1.to_q.weight"
+    ].shape == (320, 320)
+    assert manifest["down_blocks.0.attentions.0.proj_in.weight"].shape == (
+        320, 320, 1, 1)  # SD-1.x stores transformer projections as 1×1 convs
+    # every non-temporal flax param came out initialized at the right shape
+    from flax import traverse_util
+
+    out_flat = traverse_util.flatten_dict(params)
+    assert set(out_flat) == set(flat)
+    for path, leaf in out_flat.items():
+        pstr = "/".join(path)
+        if "attn_temp" in pstr or "norm_temp" in pstr:
+            continue  # kept-init: abstract leaves pass through unrealized
+        assert isinstance(leaf, np.ndarray), pstr
+        assert tuple(leaf.shape) == tuple(flat[path].shape), pstr
+
+
+def test_sd15_tuned_3d_manifest_loads_without_kept_init(sd15_manifest):
+    """A tuned Stage-1 checkpoint DOES carry the temporal keys — through
+    the same path nothing may fall back to fresh init and nothing may go
+    unconsumed."""
+    abstract, _, manifest, temporal_manifest, temporal_paths = sd15_manifest
+    assert len(temporal_manifest) == len(temporal_paths)
+    full = {**manifest, **temporal_manifest}
+    params, report = unet3d_params_from_torch(full, abstract,
+                                              strict_missing=True)
+    assert report["kept_init"] == []
+    assert report["unused"] == []

@@ -211,9 +211,15 @@ def test_cached_edit_telemetry_keeps_exact_replay(problem, sched):
     assert int(np.asarray(tel["nan_count"]).sum()) == 0
 
 
+@pytest.mark.slow
 def test_train_steps_telemetry_grad_norms():
     """Training telemetry: same losses bit-exact, plus finite per-step
-    pre-clip global gradient norms stacked by the same scan."""
+    pre-clip global gradient norms stacked by the same scan.
+
+    slow: the only remaining >10 s test in the r6 wall-clock audit (11.4 s
+    — it compiles the train scan twice, telemetry off and on); tier-1
+    keeps the telemetry bit-exactness pins via the other train test
+    (test_train.py) and the fused-pipeline off-paths above."""
     from videop2p_tpu.core import DDPMScheduler
     from videop2p_tpu.models import UNet3DConditionModel, UNet3DConfig
     from videop2p_tpu.pipelines import make_unet_fn
@@ -389,14 +395,18 @@ def test_instrumented_jit_passthrough_without_ledger():
 # -------------------------------------------------------- ledger summary --
 
 
-def _load_summary_tool():
+def _load_tool(name):
     spec = importlib.util.spec_from_file_location(
-        "ledger_summary_under_test",
-        os.path.join(_REPO, "tools", "ledger_summary.py"),
+        f"{name}_under_test",
+        os.path.join(_REPO, "tools", f"{name}.py"),
     )
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
+
+
+def _load_summary_tool():
+    return _load_tool("ledger_summary")
 
 
 def test_ledger_summary_renders_real_stream(tmp_path, problem, sched):
@@ -431,6 +441,59 @@ def test_sparkline_handles_degenerate_series():
     assert len(sparkline(list(range(500)), width=50)) == 50
 
 
+def test_ledger_summary_tolerates_empty_and_truncated(tmp_path, capsys):
+    """Satellite: the renderer must survive empty ledgers and torn/partial
+    JSONL lines (a killed run's tail) instead of crashing."""
+    mod = _load_summary_tool()
+    # empty file
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    assert mod.main(["ledger_summary.py", str(empty)]) == 0
+    assert "empty ledger" in capsys.readouterr().out
+    # torn + partial lines: valid prefix renders, junk is skipped, events
+    # missing payload fields degrade to placeholders
+    torn = tmp_path / "torn.jsonl"
+    torn.write_text("\n".join([
+        json.dumps({"event": "run_start", "run_id": "torn", "t": 0}),
+        json.dumps({"event": "phase"}),                      # no name/seconds
+        json.dumps({"event": "compile", "seconds": None}),   # null seconds
+        json.dumps({"event": "program_call", "program": "p",
+                    "dispatch_s": "garbage"}),
+        json.dumps({"event": "telemetry", "program": "p",
+                    "loss_curve": [1.0, None]}),             # junk curve
+        json.dumps({"event": "memory", "supported": True, "devices": None}),
+        json.dumps({"event": "program_analysis", "program": "q"}),
+        '{"event": "phase", "name": "tail", "secon',         # torn line
+    ]) + "\n")
+    assert mod.main(["ledger_summary.py", str(torn)]) == 0
+    out = capsys.readouterr().out
+    assert "run torn" in out
+    # missing file: usage-style error code, no traceback
+    assert mod.main(["ledger_summary.py", str(tmp_path / "nope.jsonl")]) == 2
+    # wrong argc prints usage
+    assert mod.main(["ledger_summary.py"]) == 2
+
+
+def test_ledger_summary_renders_program_analysis_and_hbm_check(tmp_path):
+    """The new program_analysis table + the predicted-vs-measured peak-HBM
+    line (the run_videop2p HBM-gate sanity check)."""
+    mod = _load_summary_tool()
+    events = [
+        {"event": "run_start", "run_id": "pa", "t": 0},
+        {"event": "program_analysis", "program": "cached_invert_edit",
+         "flops": 6.5e12, "bytes_accessed": 3 * 2**30,
+         "temp_bytes": 2 * 2**30, "peak_hbm_bytes": 4 * 2**30,
+         "hlo_instructions": 1234, "hlo_fingerprint": "deadbeefcafef00d"},
+        {"event": "memory", "supported": True,
+         "devices": [{"device": 0, "peak_bytes_in_use": 5 * 2**30}]},
+    ]
+    text = mod.render(events)
+    assert "program analysis" in text
+    assert "cached_invert_edit" in text and "deadbeefcafef00d" in text
+    assert "predicted peak-HBM" in text
+    assert "1.25× predicted" in text
+
+
 # ------------------------------------------------- overhead (CPU smoke) --
 
 
@@ -439,13 +502,17 @@ def test_telemetry_overhead_recorded_and_small(tmp_path, sched):
     program on a COMPUTE-DOMINATED workload (a matmul-heavy denoiser over a
     small latent — the real UNet's FLOPs-per-latent-byte ratio is even more
     extreme), recorded in a ledger. The stats are four scalar reductions
-    per outer step; once forwards dominate, their cost vanishes."""
-    W = 0.02 * jax.random.normal(jax.random.key(9), (512, 512))
+    per outer step; once forwards dominate, their cost vanishes.
+
+    The denoiser is sized so the fused program runs ~20 ms: the r6 audit
+    caught the original ~1.3 ms version flaking in full-suite runs, where
+    0.1 ms of host jitter reads as a fake double-digit 'overhead'."""
+    W = 0.02 * jax.random.normal(jax.random.key(9), (1024, 1024))
 
     def heavy_fn(params, sample, t, text, control=None):
         h = sample.reshape(1, -1)
-        h = jnp.pad(h, ((0, 0), (0, 512 - h.shape[1])))
-        for _ in range(8):
+        h = jnp.pad(h, ((0, 0), (0, 1024 - h.shape[1])))
+        for _ in range(24):
             h = jnp.tanh(h @ W)
         bias = jnp.mean(text, axis=(1, 2)) + jnp.mean(h)
         return 0.1 * sample + bias[:, None, None, None, None], {}
@@ -466,9 +533,9 @@ def test_telemetry_overhead_recorded_and_small(tmp_path, sched):
         jax.block_until_ready(null_text_optimization_fused(
             heavy_fn, None, sched, traj, cond, uncond, telemetry=True, **kw)[0])
 
-    rec = measure_overhead(run_off, run_on, repeats=3)
+    rec = measure_overhead(run_off, run_on, repeats=5)
     if rec["telemetry_overhead_pct"] > 5.0:  # one retry absorbs a CI blip
-        rec = measure_overhead(run_off, run_on, repeats=5)
+        rec = measure_overhead(run_off, run_on, repeats=7)
     path = str(tmp_path / "ledger.jsonl")
     with RunLedger(path) as led:
         led.telemetry("null_text_fused_overhead", rec)
@@ -483,6 +550,242 @@ def test_telemetry_overhead_record_schema():
     rec = telemetry_overhead_record(2.0, 2.05)
     assert rec == {"telemetry_off_s": 2.0, "telemetry_on_s": 2.05,
                    "telemetry_overhead_pct": 2.5}
+
+
+# ------------------------------------- program introspection (ISSUE 3) --
+
+
+def _tanh_matmul():
+    # module-level name keeps the HLO module name (and so the fingerprint)
+    # identical across fresh jit wrappers
+    def cost_probe(x):
+        return jnp.tanh(x @ x) + 1
+
+    return cost_probe
+
+
+def test_analyze_jitted_schema_and_determinism():
+    """The acceptance pin: the analysis record is shape-stable and
+    DETERMINISTIC across two independent compiles of the same program on
+    CPU — fingerprints, flops, histograms, everything."""
+    from videop2p_tpu.obs import analyze_jitted
+
+    sds = jax.ShapeDtypeStruct((16, 16), jnp.float32)
+    rec1 = analyze_jitted(jax.jit(_tanh_matmul()), sds)
+    jax.clear_caches()
+    rec2 = analyze_jitted(jax.jit(_tanh_matmul()), sds)
+    assert rec1 == rec2
+    for key in ("flops", "transcendentals", "bytes_accessed",
+                "argument_bytes", "output_bytes", "temp_bytes",
+                "alias_bytes", "generated_code_bytes", "peak_hbm_bytes",
+                "hlo_fingerprint", "hlo_instructions", "hlo_histogram"):
+        assert key in rec1, key
+    assert rec1["flops"] > 0
+    assert rec1["peak_hbm_bytes"] == (
+        rec1["argument_bytes"] + rec1["output_bytes"] + rec1["temp_bytes"]
+        + rec1["generated_code_bytes"] - rec1["alias_bytes"]
+    )
+    assert sum(rec1["hlo_histogram"].values()) == rec1["hlo_instructions"]
+    assert "dot" in rec1["hlo_histogram"]
+    # a different program fingerprints differently
+    rec3 = analyze_jitted(jax.jit(lambda x: x + 1), sds)
+    assert rec3["hlo_fingerprint"] != rec1["hlo_fingerprint"]
+    # analysis is best-effort: garbage in → None, not an exception
+    assert analyze_jitted(jax.jit(lambda x: x.bad_attr), sds) is None
+
+
+def test_instrumented_jit_emits_analysis_on_miss_only(tmp_path):
+    """One program_analysis event per compile (cache miss), none on hits,
+    attributed to the program label, with the numeric metrics identical
+    across two runs of the same program."""
+    recs = []
+    for i in range(2):
+        path = str(tmp_path / f"ledger{i}.jsonl")
+        f = instrumented_jit(_tanh_matmul(), program="cost_probe")
+        with RunLedger(path):
+            f(jnp.ones((16, 16)))
+            f(jnp.ones((16, 16)))  # hit: no second analysis
+        events = read_ledger(path)
+        pa = [e for e in events if e["event"] == "program_analysis"]
+        assert len(pa) == 1
+        assert pa[0]["program"] == "cost_probe"
+        recs.append({k: v for k, v in pa[0].items() if k != "t"})
+        jax.clear_caches()
+    assert recs[0] == recs[1]
+
+
+def test_program_analysis_kill_switch_and_ledger_off(tmp_path, monkeypatch):
+    f = instrumented_jit(lambda x: x * 2, program="doubler")
+    # no active ledger: plain passthrough, nothing recorded anywhere
+    assert float(f(jnp.asarray(2.0))) == 4.0
+    # active ledger + kill-switch: program_call still recorded, analysis not
+    monkeypatch.setenv("VIDEOP2P_OBS_NO_ANALYSIS", "1")
+    path = str(tmp_path / "ledger.jsonl")
+    g = instrumented_jit(lambda x: x * 3, program="tripler")
+    with RunLedger(path):
+        g(jnp.asarray(2.0))
+    kinds = [e["event"] for e in read_ledger(path)]
+    assert "program_call" in kinds
+    assert "program_analysis" not in kinds
+
+
+def test_null_text_programs_emit_analysis(problem, sched, tmp_path):
+    """The pipelines' internal jits (fused + chunked null-text) are
+    instrumented where the CLI's wrappers cannot reach — both land
+    program_analysis events with distinct fingerprints."""
+    fn, _, cond, uncond, traj = problem
+    path = str(tmp_path / "ledger.jsonl")
+    with RunLedger(path):
+        null_text_optimization_fused(
+            fn, None, sched, traj, cond, uncond,
+            num_inference_steps=STEPS, num_inner_steps=2,
+        )
+        null_text_optimization(
+            fn, None, sched, traj, cond, uncond,
+            num_inference_steps=STEPS, num_inner_steps=2, outer_chunk=3,
+        )
+    pa = {e["program"]: e for e in read_ledger(path)
+          if e["event"] == "program_analysis"}
+    assert set(pa) == {"null_text_fused", "null_text_chunked"}
+    for e in pa.values():
+        assert e["flops"] > 0 and len(e["hlo_fingerprint"]) == 16
+    assert (pa["null_text_fused"]["hlo_fingerprint"]
+            != pa["null_text_chunked"]["hlo_fingerprint"])
+
+
+# -------------------------------------------- run history + regression --
+
+
+def _write_run(path, run_id, wall_time, analyses, phases=()):
+    """Synthetic ledger run: program_analysis + phase events with
+    controlled values (RunLedger stamps run_start/run_end around them)."""
+    led = RunLedger(path, run_id=run_id, device_info=False)
+    # overwrite the auto wall_time for deterministic ordering
+    led.event("run_start_patch")  # no-op marker; ordering uses run_start
+    for prog, rec in analyses.items():
+        led.program_analysis(prog, rec)
+    for name, secs in phases:
+        led.phase(name, secs)
+    led.close()
+    # rewrite wall_time in-place (the ledger stamped now())
+    import json as _json
+
+    lines = []
+    for line in open(path):
+        e = _json.loads(line)
+        if e.get("event") == "run_start" and e.get("run_id") == run_id:
+            e["wall_time"] = wall_time
+        lines.append(_json.dumps(e))
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+_ANALYSIS_A = {"flops": 1000, "bytes_accessed": 10 * 2**20,
+               "temp_bytes": 100 * 2**20, "peak_hbm_bytes": 200 * 2**20,
+               "hlo_instructions": 500, "hlo_fingerprint": "aaaa"}
+
+
+def test_run_history_scan_series_and_baseline(tmp_path):
+    from videop2p_tpu.obs import RunHistory
+
+    d = str(tmp_path)
+    _write_run(os.path.join(d, "r1.jsonl"), "r1", "2026-08-01T00:00:00Z",
+               {"edit": _ANALYSIS_A}, phases=[("edit_phase", 10.0)])
+    # two runs APPENDED into one file (ledgers open append-mode)
+    p2 = os.path.join(d, "r2.jsonl")
+    _write_run(p2, "r2", "2026-08-02T00:00:00Z", {"edit": _ANALYSIS_A})
+    _write_run(p2, "r3", "2026-08-03T00:00:00Z",
+               {"edit": {**_ANALYSIS_A, "temp_bytes": 120 * 2**20}})
+    hist = RunHistory.scan(d)
+    assert [r["run_id"] for r in hist.runs] == ["r1", "r2", "r3"]
+    series = hist.series("temp_bytes")
+    # keyed by (label, fingerprint): same program+fingerprint = one series
+    assert set(series) == {("edit", "aaaa")}
+    assert [v for _, v in series[("edit", "aaaa")]] == [
+        100 * 2**20, 100 * 2**20, 120 * 2**20]
+    latest = hist.latest()
+    assert latest["run_id"] == "r3"
+    base = hist.baseline_for(latest)
+    assert base["run_id"] == "r2"
+
+
+def test_regression_rules_flag_injected_regression(tmp_path):
+    from videop2p_tpu.obs import evaluate_rules, extract_run, split_runs
+
+    d = str(tmp_path)
+    _write_run(os.path.join(d, "a.jsonl"), "a", "2026-08-01T00:00:00Z",
+               {"edit": _ANALYSIS_A}, phases=[("p", 10.0)])
+    _write_run(os.path.join(d, "b.jsonl"), "b", "2026-08-02T00:00:00Z",
+               {"edit": {**_ANALYSIS_A,
+                         "temp_bytes": int(_ANALYSIS_A["temp_bytes"] * 1.2),
+                         "hlo_fingerprint": "bbbb"}},
+               phases=[("p", 10.1)])
+    base = extract_run(split_runs(read_ledger(os.path.join(d, "a.jsonl")))[0])
+    new = extract_run(split_runs(read_ledger(os.path.join(d, "b.jsonl")))[0])
+    # self-compare: always clean
+    assert evaluate_rules(base, base)["pass"]
+    res = evaluate_rules(base, new)
+    assert not res["pass"]
+    regs = {(v["metric"], v["program"]) for v in res["regressions"]}
+    assert regs == {("temp_bytes", "edit")}  # +20% temp, phases within noise
+    [v] = res["regressions"]
+    assert v["delta_pct"] == 20.0
+    assert v["fingerprint_changed"] is True
+    # the phase verdict exists but is under threshold
+    phase_v = [x for x in res["verdicts"] if x["kind"] == "phase"]
+    assert phase_v and not phase_v[0]["regressed"]
+
+
+def test_extract_run_tolerates_partial_events(tmp_path):
+    """A torn tail (killed run) can leave half-records: extraction and
+    rendering must survive events missing their payload fields."""
+    from videop2p_tpu.obs import extract_run
+
+    rec = extract_run([
+        {"event": "phase"},  # no name/seconds
+        {"event": "compile", "seconds": "junk"},
+        {"event": "program_call", "program": "x"},
+        {"event": "program_analysis"},  # no program/metrics
+        {"not_even": "an event"},
+    ])
+    assert rec["run_id"] is None
+    assert rec["phases"]["?"]["calls"] == 1
+    assert "(unattributed)" in rec["programs"]
+
+
+def test_obs_diff_cli_self_zero_and_regression_nonzero(tmp_path, capsys):
+    """The acceptance gate: obs_diff exits 0 comparing a ledger against
+    itself and nonzero on a synthetically injected +20% temp-bytes
+    regression; --history mode agrees."""
+    mod = _load_tool("obs_diff")
+    d = str(tmp_path)
+    a = os.path.join(d, "a.jsonl")
+    b = os.path.join(d, "b.jsonl")
+    _write_run(a, "a", "2026-08-01T00:00:00Z", {"edit": _ANALYSIS_A})
+    _write_run(b, "b", "2026-08-02T00:00:00Z",
+               {"edit": {**_ANALYSIS_A,
+                         "temp_bytes": int(_ANALYSIS_A["temp_bytes"] * 1.2)}})
+    assert mod.main(["obs_diff.py", a, a]) == 0
+    out = capsys.readouterr().out
+    assert "no regressions" in out
+    assert mod.main(["obs_diff.py", a, b]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSIONS" in out and "temp_bytes" in out
+    # --history picks the prior run as baseline for the latest
+    assert mod.main(["obs_diff.py", "--history", d]) == 1
+    # threshold scaling can wave it through
+    assert mod.main(["obs_diff.py", "--threshold-scale", "3.0", a, b]) == 0
+    # unreadable input is usage error, not a crash
+    assert mod.main(["obs_diff.py", a, os.path.join(d, "missing.jsonl")]) == 2
+
+
+def test_obs_diff_json_output_is_machine_readable(tmp_path, capsys):
+    mod = _load_tool("obs_diff")
+    a = str(tmp_path / "a.jsonl")
+    _write_run(a, "a", "2026-08-01T00:00:00Z", {"edit": _ANALYSIS_A})
+    assert mod.main(["obs_diff.py", "--json", a, a]) == 0
+    verdict = json.loads(capsys.readouterr().out)
+    assert verdict["pass"] is True and verdict["regressions"] == []
 
 
 # --------------------------------------------------------- CLI e2e (slow) --
@@ -521,6 +824,15 @@ def test_cli_full_mode_writes_acceptance_ledger(tmp_path):
     assert rec["latent"]["nan_total"] == 0
     phases = [e["name"] for e in events if e["event"] == "phase"]
     assert "null_text_optimization" in phases
+    # ISSUE 3: every instrumented program's compile was mined into a
+    # program_analysis event — including the pipeline-internal fused
+    # null-text jit the CLI wrappers cannot reach
+    pa = {e["program"]: e for e in events
+          if e["event"] == "program_analysis"}
+    assert "null_text_fused" in pa and "vae_encode" in pa
+    for e in pa.values():
+        assert e["flops"] > 0 and len(e["hlo_fingerprint"]) == 16
     mod = _load_summary_tool()
     text = mod.render(events)
     assert "null_text_fused" in text and "inner steps" in text
+    assert "program analysis" in text

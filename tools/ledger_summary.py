@@ -5,8 +5,15 @@ Usage:  python tools/ledger_summary.py <ledger.jsonl>
 Prints the run header (run_id / git sha / jax / backend), a per-phase
 wall-clock table, a per-program compile-vs-execute table (compile events
 attributed by program label, program_call dispatch times with cache
-hit/miss counts), telemetry summaries with a loss-curve sparkline for the
+hit/miss counts), a per-program XLA cost/memory-analysis table
+(``program_analysis`` events — flops, bytes, temp/peak HBM, HLO
+fingerprint) with a predicted-vs-measured peak-HBM line when memory
+snapshots exist, telemetry summaries with a loss-curve sparkline for the
 fused null-text program, training-metric and memory-snapshot digests.
+
+Tolerates empty ledgers and truncated/partial JSONL lines (a killed run's
+torn tail): malformed events render as far as their fields allow instead
+of crashing the renderer. Diff two ledgers with ``tools/obs_diff.py``.
 """
 
 from __future__ import annotations
@@ -34,8 +41,25 @@ def _table(rows: List[List[str]], header: List[str]) -> str:
     return "\n".join(lines)
 
 
+def _f(v, default: float = 0.0) -> float:
+    """Float, tolerating the junk a torn/partial JSONL line can carry."""
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return default
+
+
+def _mb(v) -> str:
+    return f"{_f(v) / 2**20:.1f}M"
+
+
 def render(events: List[Dict]) -> str:
-    """The full summary as one string (pure — tests feed synthetic events)."""
+    """The full summary as one string (pure — tests feed synthetic events).
+    Tolerant of empty event lists and partial events: every field access
+    degrades to a placeholder rather than raising."""
+    events = [e for e in events if isinstance(e, dict)]
+    if not events:
+        return "(empty ledger — no events)"
     out: List[str] = []
     start = next((e for e in events if e.get("event") == "run_start"), {})
     out.append(
@@ -48,7 +72,7 @@ def render(events: List[Dict]) -> str:
     phases: Dict[str, List[float]] = defaultdict(list)
     for e in events:
         if e.get("event") == "phase":
-            phases[e.get("name", "?")].append(float(e.get("seconds", 0.0)))
+            phases[e.get("name") or "?"].append(_f(e.get("seconds")))
     if phases:
         rows = [[name, len(ts), f"{sum(ts):.2f}", f"{ts[-1]:.2f}"]
                 for name, ts in phases.items()]
@@ -62,13 +86,13 @@ def render(events: List[Dict]) -> str:
     for e in events:
         if e.get("event") == "compile":
             compiles[e.get("program") or "(unattributed)"].append(
-                float(e.get("seconds", 0.0))
+                _f(e.get("seconds"))
             )
         elif e.get("event") == "program_call":
             c = calls[e.get("program") or "(unattributed)"]
             c["n"] += 1
             c["miss"] += 1 if e.get("cache_miss") else 0
-            c["dispatch_s"] += float(e.get("dispatch_s", 0.0))
+            c["dispatch_s"] += _f(e.get("dispatch_s"))
     if compiles or calls:
         rows = []
         for prog in sorted(set(compiles) | set(calls)):
@@ -83,19 +107,43 @@ def render(events: List[Dict]) -> str:
                 _table(rows, ["program", "compiles", "compile_s",
                               "calls", "misses", "execute_s"])]
 
+    # program_analysis: what XLA built per program (obs/introspect.py)
+    analyses: Dict[str, Dict] = {}
+    for e in events:
+        if e.get("event") == "program_analysis":
+            analyses[e.get("program") or "(unattributed)"] = e
+    if analyses:
+        rows = [[
+            prog,
+            f"{_f(a.get('flops')) / 1e9:.2f}G",
+            _mb(a.get("bytes_accessed")),
+            _mb(a.get("temp_bytes")),
+            _mb(a.get("peak_hbm_bytes")),
+            str(a.get("hlo_instructions", "-")),
+            str(a.get("hlo_fingerprint", "-")),
+        ] for prog, a in sorted(analyses.items())]
+        out += ["", "program analysis (XLA cost/memory of the compiled "
+                "programs):",
+                _table(rows, ["program", "flops", "bytes", "temp",
+                              "peak_hbm", "instrs", "hlo_fingerprint"])]
+
     tel_lines: List[str] = []
     for e in events:
         if e.get("event") != "telemetry":
             continue
         prog = e.get("program", "?")
         if e.get("loss_curve"):
+            try:
+                spark = sparkline(e["loss_curve"])
+            except (TypeError, ValueError):
+                spark = "?"
             tel_lines.append(
-                f"  {prog}: loss {sparkline(e['loss_curve'])} "
+                f"  {prog}: loss {spark} "
                 f"(final {e.get('loss_final')}), inner steps "
                 f"{e.get('inner_steps_total')} total"
             )
         summary = e.get("summary") or e.get("latent")
-        if summary:
+        if isinstance(summary, dict):
             nan = summary.get("nan_total", 0)
             tel_lines.append(
                 f"  {prog}: abs_max peak {summary.get('abs_max_peak')} / "
@@ -122,16 +170,35 @@ def render(events: List[Dict]) -> str:
                             if k not in ("event", "t")))
         out += ["", "train metrics:", line]
         if curve:
-            out.append(f"  loss {sparkline(curve)}")
+            try:
+                out.append(f"  loss {sparkline(curve)}")
+            except (TypeError, ValueError):
+                pass
 
     mems = [e for e in events if e.get("event") == "memory" and e.get("supported")]
     if mems:
         peak = max(
-            (d.get("peak_bytes_in_use") or 0)
-            for e in mems for d in e.get("devices", [])
+            (_f(d.get("peak_bytes_in_use")) for e in mems
+             for d in (e.get("devices") or []) if isinstance(d, dict)),
+            default=0.0,
         )
         out += ["", f"memory: {len(mems)} snapshots, peak "
                 f"{peak / 2**30:.2f} GiB in use"]
+        # predicted-vs-measured: the largest per-program peak-HBM estimate
+        # (XLA memory_analysis) against the device's measured peak — the
+        # HBM-gate sanity line (predicted covers ONE program's residency;
+        # measured can exceed it when executables/buffers coexist)
+        if analyses:
+            pred_prog, pred = max(
+                ((p, _f(a.get("peak_hbm_bytes"))) for p, a in analyses.items()),
+                key=lambda kv: kv[1],
+            )
+            if pred > 0 and peak > 0:
+                out.append(
+                    f"  predicted peak-HBM (largest program, {pred_prog}): "
+                    f"{pred / 2**30:.2f} GiB vs measured {peak / 2**30:.2f} "
+                    f"GiB ({peak / pred:.2f}× predicted)"
+                )
 
     end = next((e for e in events if e.get("event") == "run_end"), None)
     if end is not None:
@@ -144,7 +211,12 @@ def main(argv: List[str]) -> int:
     if len(argv) != 2:
         print(__doc__.strip(), file=sys.stderr)
         return 2
-    print(render(read_ledger(argv[1])))
+    try:
+        events = read_ledger(argv[1])
+    except OSError as e:
+        print(f"ledger_summary: cannot read {argv[1]}: {e}", file=sys.stderr)
+        return 2
+    print(render(events))
     return 0
 
 

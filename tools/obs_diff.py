@@ -1,0 +1,227 @@
+"""Diff two run ledgers (or latest-vs-history) and exit nonzero on regression.
+
+Usage:
+  python tools/obs_diff.py BASE.jsonl NEW.jsonl
+  python tools/obs_diff.py --history DIR NEW.jsonl
+  python tools/obs_diff.py --history DIR            # latest vs its baseline
+
+Compares the ``program_analysis`` events (XLA cost/memory analysis, HLO
+fingerprints — obs/introspect.py), per-program compile seconds, and phase
+wall-clock between a baseline run and a new run, renders per-program
+tables, evaluates the declarative regression rules (obs/history.py
+DEFAULT_RULES; scale every threshold with ``--threshold-scale``), and:
+
+  exit 0 — no rule regressed (a ledger compared against itself is always 0)
+  exit 1 — at least one regression verdict
+  exit 2 — usage / unreadable input
+
+``--json`` additionally prints the machine-readable verdict object on
+stdout (the tables move to stderr). CPU-runnable — this is the tier-1 CI
+gate for "did this change make the compiled programs bigger".
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from videop2p_tpu.obs.history import (  # noqa: E402
+    DEFAULT_RULES,
+    RegressionRule,
+    RunHistory,
+    evaluate_rules,
+    extract_run,
+    split_runs,
+)
+from videop2p_tpu.obs.ledger import read_ledger  # noqa: E402
+
+
+def _fmt(v: float) -> str:
+    """Human-scaled number: bytes/flops get unit suffixes, small floats stay
+    plain."""
+    if v is None:
+        return "-"
+    for scale, suffix in ((1e12, "T"), (1e9, "G"), (1e6, "M"), (1e3, "K")):
+        if abs(v) >= scale:
+            return f"{v / scale:.2f}{suffix}"
+    return f"{v:.4g}"
+
+
+def _table(rows: List[List[str]], header: List[str]) -> str:
+    widths = [max(len(str(r[i])) for r in [header] + rows)
+              for i in range(len(header))]
+    lines = ["  ".join(str(h).ljust(w) for h, w in zip(header, widths)),
+             "  ".join("-" * w for w in widths)]
+    lines += ["  ".join(str(c).ljust(w) for c, w in zip(r, widths))
+              for r in rows]
+    return "\n".join(lines)
+
+
+def render_diff(base: Dict, new: Dict, result: Dict) -> str:
+    """The per-program / per-phase comparison tables plus the verdict list,
+    as one string (pure — tests feed synthetic records)."""
+    out: List[str] = [
+        f"base: run {base.get('run_id', '?')} at {base.get('wall_time', '?')}"
+        + (f"  ({base.get('source')})" if base.get("source") else ""),
+        f"new:  run {new.get('run_id', '?')} at {new.get('wall_time', '?')}"
+        + (f"  ({new.get('source')})" if new.get("source") else ""),
+    ]
+
+    progs = sorted(set(base.get("programs", {})) | set(new.get("programs", {})))
+    if progs:
+        rows = []
+        for label in progs:
+            b = base.get("programs", {}).get(label, {})
+            n = new.get("programs", {}).get(label, {})
+            fp_b, fp_n = b.get("hlo_fingerprint"), n.get("hlo_fingerprint")
+
+            def cell(metric, b=b, n=n):
+                bv, nv = b.get(metric), n.get(metric)
+                if bv is None and nv is None:
+                    return "-"
+                if bv is None or nv is None:
+                    return f"{_fmt(bv)} → {_fmt(nv)}"
+                if bv == nv:
+                    return _fmt(nv)
+                pct = (nv / bv - 1.0) * 100.0 if bv else float("inf")
+                return f"{_fmt(bv)} → {_fmt(nv)} ({pct:+.1f}%)"
+
+            rows.append([
+                label, cell("flops"), cell("bytes_accessed"),
+                cell("temp_bytes"), cell("peak_hbm_bytes"),
+                cell("hlo_instructions"),
+                ("same" if fp_b == fp_n else "CHANGED") if fp_b and fp_n
+                else "-",
+            ])
+        out += ["", "programs (XLA cost/memory analysis):",
+                _table(rows, ["program", "flops", "bytes", "temp",
+                              "peak_hbm", "instrs", "hlo"])]
+
+    names = sorted(set(base.get("phases", {})) | set(new.get("phases", {})))
+    if names:
+        rows = []
+        for name in names:
+            b = base.get("phases", {}).get(name, {}).get("seconds")
+            n = new.get("phases", {}).get(name, {}).get("seconds")
+            delta = (f"{(n / b - 1.0) * 100.0:+.1f}%"
+                     if b and n is not None else "-")
+            rows.append([name,
+                         "-" if b is None else f"{b:.2f}",
+                         "-" if n is None else f"{n:.2f}", delta])
+        out += ["", "phases (wall-clock s):",
+                _table(rows, ["phase", "base", "new", "delta"])]
+
+    comp = sorted(set(base.get("compiles", {})) | set(new.get("compiles", {})))
+    if comp:
+        rows = []
+        for label in comp:
+            b = base.get("compiles", {}).get(label, {}).get("seconds")
+            n = new.get("compiles", {}).get(label, {}).get("seconds")
+            rows.append([label,
+                         "-" if b is None else f"{b:.2f}",
+                         "-" if n is None else f"{n:.2f}"])
+        out += ["", "compile seconds:",
+                _table(rows, ["program", "base", "new"])]
+
+    regs = result["regressions"]
+    if regs:
+        out += ["", f"REGRESSIONS ({len(regs)}):"]
+        for v in regs:
+            pct = ("new" if v["delta_pct"] is None else f"{v['delta_pct']:+.1f}%")
+            note = (" [HLO fingerprint changed — XLA built a different program]"
+                    if v.get("fingerprint_changed") else "")
+            out.append(
+                f"  {v['rule']}  {v['program']}: "
+                f"{_fmt(v['base'])} → {_fmt(v['new'])} ({pct}){note}"
+            )
+    else:
+        out += ["", "no regressions"]
+    return "\n".join(out)
+
+
+def _load_run(path: str) -> Optional[Dict]:
+    """LAST run in a ledger file (files append across invocations)."""
+    try:
+        runs = split_runs(read_ledger(path))
+    except OSError as e:
+        print(f"obs_diff: cannot read {path}: {e}", file=sys.stderr)
+        return None
+    if not runs:
+        print(f"obs_diff: {path} holds no events", file=sys.stderr)
+        return None
+    return extract_run(runs[-1], source=path)
+
+
+def main(argv: List[str]) -> int:
+    parser = argparse.ArgumentParser(
+        prog="obs_diff.py", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument("ledgers", nargs="*",
+                        help="BASE.jsonl NEW.jsonl — or just NEW.jsonl with "
+                             "--history")
+    parser.add_argument("--history", type=str, default=None,
+                        help="directory of ledger JSONLs; the baseline is "
+                             "the most recent prior run sharing program "
+                             "labels with the new run")
+    parser.add_argument("--threshold-scale", type=float, default=1.0,
+                        help="multiply every rule threshold (2.0 = twice as "
+                             "tolerant)")
+    parser.add_argument("--json", action="store_true",
+                        help="print the machine-readable verdict object on "
+                             "stdout (tables go to stderr)")
+    args = parser.parse_args(argv[1:])
+
+    if args.history is not None:
+        if len(args.ledgers) > 1:
+            parser.print_usage(sys.stderr)
+            return 2
+        try:
+            hist = RunHistory.scan(args.history)
+        except OSError as e:
+            print(f"obs_diff: cannot scan {args.history}: {e}", file=sys.stderr)
+            return 2
+        new = _load_run(args.ledgers[0]) if args.ledgers else hist.latest()
+        if new is None:
+            print("obs_diff: no new run to compare", file=sys.stderr)
+            return 2
+        base = hist.baseline_for(new)
+        if base is None:
+            print("obs_diff: history holds no baseline run — nothing to "
+                  "compare (pass)", file=sys.stderr)
+            return 0
+    else:
+        if len(args.ledgers) != 2:
+            parser.print_usage(sys.stderr)
+            print(__doc__.strip(), file=sys.stderr)
+            return 2
+        base = _load_run(args.ledgers[0])
+        new = _load_run(args.ledgers[1])
+        if base is None or new is None:
+            return 2
+
+    rules = tuple(
+        RegressionRule(r.metric, kind=r.kind,
+                       threshold_pct=r.threshold_pct * args.threshold_scale,
+                       min_abs=r.min_abs, programs=r.programs)
+        for r in DEFAULT_RULES
+    )
+    result = evaluate_rules(base, new, rules)
+    text = render_diff(base, new, result)
+    if args.json:
+        print(text, file=sys.stderr)
+        print(json.dumps(result))
+    else:
+        print(text)
+    return 0 if result["pass"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv))

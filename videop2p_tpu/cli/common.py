@@ -158,9 +158,17 @@ def add_obs_args(parser: argparse.ArgumentParser) -> None:
     parser.add_argument(
         "--ledger", type=str, default=None,
         help="write a JSONL run ledger (phases, XLA compile events, "
-             "telemetry summaries, memory snapshots) to this path; "
-             "default when --telemetry is set: <output dir>/run_ledger.jsonl. "
-             "Render with tools/ledger_summary.py",
+             "telemetry summaries, memory snapshots, per-program XLA "
+             "cost/memory analyses) to this path; default when --telemetry "
+             "is set: <output dir>/run_ledger.jsonl. Render with "
+             "tools/ledger_summary.py; diff runs with tools/obs_diff.py",
+    )
+    parser.add_argument(
+        "--no_program_analysis", action="store_true",
+        help="skip the automatic compiled-program introspection "
+             "(cost/memory analysis + HLO fingerprint per instrumented "
+             "program on each compile) — it re-lowers each program "
+             "ahead-of-time, which is persistent-cache-cheap but not free",
     )
 
 

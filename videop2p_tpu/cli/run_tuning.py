@@ -100,10 +100,15 @@ def main(
     # riding the train scan + a JSONL run ledger
     telemetry: bool = False,
     ledger: Optional[str] = None,
+    # automatic XLA cost/memory analysis of each instrumented program on
+    # compile (program_analysis ledger events; obs/introspect.py)
+    program_analysis: bool = True,
     **unused,
 ) -> str:
     del unused
     enable_compile_cache()
+    if not program_analysis:
+        os.environ["VIDEOP2P_OBS_NO_ANALYSIS"] = "1"
     n_frames = int(train_data.get("n_sample_frames", 8))
     output_dir = output_dir + dependent_suffix(
         dependent=dependent, decay_rate=decay_rate, window_size=window_size,
@@ -411,4 +416,5 @@ if __name__ == "__main__":
         tiny=args.tiny,
         telemetry=args.telemetry,
         ledger=args.ledger,
+        program_analysis=not args.no_program_analysis,
     )

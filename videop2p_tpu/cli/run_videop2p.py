@@ -110,11 +110,18 @@ def main(
     # fused scans + a JSONL run ledger (phases, compile events, memory)
     telemetry: bool = False,
     ledger: Optional[str] = None,
+    # automatic XLA cost/memory analysis of each instrumented program on
+    # compile (program_analysis ledger events; obs/introspect.py) — the
+    # per-program peak-HBM estimate the memory snapshots are checked
+    # against, and what tools/obs_diff.py regresses across runs
+    program_analysis: bool = True,
     **unused,
 ) -> Tuple[str, str]:
     """Returns the (inversion_gif, edit_gif) paths it wrote."""
     del unused
     enable_compile_cache()
+    if not program_analysis:
+        os.environ["VIDEOP2P_OBS_NO_ANALYSIS"] = "1"
     if tiny and width == 512:
         # the tiny VAE downsamples 2×, not 8× — keep latents at the tiny
         # UNet's 8×8 working point so smoke runs stay small
@@ -390,6 +397,11 @@ def main(
                     {"summary": summarize_step_stats(res[2]),
                      "steps": decode_step_stats(res[2])},
                 )
+        if run_ledger is not None:
+            # measured peak next to the program_analysis predicted peak-HBM
+            # (the instrumented_jit cache miss above recorded it) — the
+            # ledger summary renders predicted-vs-actual from these two
+            run_ledger.memory_snapshot(note="after_cached_edit")
         print(f"[p2p] cached invert+edit+decode done in "
               f"{time.perf_counter() - t0:.1f}s")
         if reuse_inversion:
@@ -639,4 +651,5 @@ if __name__ == "__main__":
         reuse_inversion=not args.no_reuse_inversion,
         telemetry=args.telemetry,
         ledger=args.ledger,
+        program_analysis=not args.no_program_analysis,
     )

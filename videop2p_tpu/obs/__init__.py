@@ -1,6 +1,7 @@
-"""Observability: in-program telemetry + the unified run ledger.
+"""Observability: in-program telemetry, the unified run ledger, and
+compiled-program introspection with a cross-run regression engine.
 
-Two pillars (ISSUE 2):
+Four pillars (ISSUEs 2 and 3):
 
   * :mod:`videop2p_tpu.obs.telemetry` — fixed-shape telemetry buffers that
     ride the fused pipelines' existing ``lax.scan`` outputs (zero extra
@@ -11,14 +12,38 @@ Two pillars (ISSUE 2):
     emits into the active ledger), XLA compile events (``jax.monitoring``
     listener + :func:`instrumented_jit` cache-miss attribution), decoded
     telemetry summaries, and device memory snapshots.
+  * :mod:`videop2p_tpu.obs.introspect` — XLA ``cost_analysis`` /
+    ``memory_analysis`` / optimized-HLO fingerprint + instruction
+    histogram of every instrumented program, emitted as
+    ``program_analysis`` events on each compile (cache miss) — available
+    on CPU even when the accelerator is down.
+  * :mod:`videop2p_tpu.obs.history` — :class:`RunHistory` scans ledger
+    directories, keys metric series by (program label, HLO fingerprint),
+    and evaluates declarative :class:`RegressionRule` thresholds into
+    machine-readable verdicts (``tools/obs_diff.py`` is the CLI).
 
 Everything here is OFF by default: with no active ledger and
 ``telemetry=False`` the fused programs are bit-identical to their
 un-instrumented forms (tests/test_obs.py pins this).
 """
 
+from videop2p_tpu.obs.history import (
+    DEFAULT_RULES,
+    RegressionRule,
+    RunHistory,
+    evaluate_rules,
+    extract_run,
+    split_runs,
+)
+from videop2p_tpu.obs.introspect import (
+    analyze_compiled,
+    analyze_jitted,
+    hlo_fingerprint,
+    instruction_histogram,
+)
 from videop2p_tpu.obs.ledger import (
     RunLedger,
+    analysis_enabled,
     current_ledger,
     instrumented_jit,
     program_label,
@@ -39,6 +64,17 @@ __all__ = [
     "instrumented_jit",
     "program_label",
     "read_ledger",
+    "analysis_enabled",
+    "analyze_compiled",
+    "analyze_jitted",
+    "hlo_fingerprint",
+    "instruction_histogram",
+    "RunHistory",
+    "RegressionRule",
+    "DEFAULT_RULES",
+    "evaluate_rules",
+    "extract_run",
+    "split_runs",
     "latent_stats",
     "decode_step_stats",
     "decode_null_text_stats",

@@ -1,0 +1,289 @@
+"""RunHistory: a cross-run regression engine over run-ledger JSONL files.
+
+``obs/introspect.py`` records what XLA built per program (flops, bytes,
+temp-HBM, an optimized-HLO fingerprint) as ``program_analysis`` ledger
+events; this module closes the loop across runs:
+
+  * :func:`split_runs` / :func:`extract_run` — a ledger file (which appends
+    across invocations, so one file can hold many runs) becomes a list of
+    flat per-run records: per-program analysis metrics + fingerprints,
+    per-phase wall-clock, per-program compile seconds and dispatch
+    seconds;
+  * :class:`RunHistory` — scans a directory of ledgers, orders runs
+    chronologically, and keys metric series by ``(program_label,
+    hlo_fingerprint)`` so a program that XLA rebuilt differently starts a
+    new series instead of polluting the old one;
+  * :class:`RegressionRule` / :func:`evaluate_rules` — declarative
+    thresholds (``temp_bytes`` +10 %, ``compile_s`` +50 %, phase seconds
+    +25 %, ...) evaluated into machine-readable verdicts. A verdict is a
+    plain dict; ``tools/obs_diff.py`` renders them and exits nonzero when
+    any regressed.
+
+Everything here is pure host-side JSON plumbing — CPU-runnable, tier-1
+testable, no jax required beyond what the ledger reader already imports.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import os
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from videop2p_tpu.obs.introspect import PROGRAM_METRICS
+from videop2p_tpu.obs.ledger import read_ledger
+
+__all__ = [
+    "RegressionRule",
+    "DEFAULT_RULES",
+    "split_runs",
+    "extract_run",
+    "evaluate_rules",
+    "RunHistory",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class RegressionRule:
+    """One declarative threshold: flag when ``metric`` grows more than
+    ``threshold_pct`` percent over baseline (all tracked metrics — flops,
+    bytes, seconds — regress by growing).
+
+    ``kind`` selects the record section the metric lives in: ``"program"``
+    (program_analysis metrics), ``"compile"`` (per-program compile
+    seconds), ``"phase"`` (phase wall-clock), ``"dispatch"`` (program_call
+    dispatch seconds). ``min_abs`` suppresses verdicts whose absolute delta
+    is noise-sized (a 0.001 s phase doubling is not a regression).
+    ``programs`` (labels for program/compile/dispatch kinds, phase names
+    for phases) restricts the rule; None applies it everywhere.
+    """
+
+    metric: str
+    kind: str = "program"
+    threshold_pct: float = 10.0
+    min_abs: float = 0.0
+    programs: Optional[Tuple[str, ...]] = None
+
+    @property
+    def name(self) -> str:
+        return f"{self.kind}:{self.metric}+{self.threshold_pct:g}%"
+
+
+DEFAULT_RULES: Tuple[RegressionRule, ...] = (
+    RegressionRule("flops", threshold_pct=10.0),
+    RegressionRule("bytes_accessed", threshold_pct=15.0, min_abs=1 << 20),
+    RegressionRule("temp_bytes", threshold_pct=10.0, min_abs=1 << 20),
+    RegressionRule("peak_hbm_bytes", threshold_pct=10.0, min_abs=1 << 20),
+    RegressionRule("hlo_instructions", threshold_pct=25.0, min_abs=16),
+    RegressionRule("seconds", kind="compile", threshold_pct=50.0, min_abs=1.0),
+    RegressionRule("seconds", kind="phase", threshold_pct=25.0, min_abs=0.5),
+)
+
+
+def split_runs(events: Iterable[Dict[str, Any]]) -> List[List[Dict[str, Any]]]:
+    """Split one ledger event stream on ``run_start`` boundaries (ledger
+    files open append-mode, so repeat invocations stack runs in one file).
+    Events before the first run_start (a truncated head) form their own
+    run so nothing is silently dropped."""
+    runs: List[List[Dict[str, Any]]] = []
+    for e in events:
+        if not isinstance(e, dict):
+            continue
+        if e.get("event") == "run_start" or not runs:
+            runs.append([])
+        runs[-1].append(e)
+    return runs
+
+
+def extract_run(events: Sequence[Dict[str, Any]],
+                source: Optional[str] = None) -> Dict[str, Any]:
+    """One run's events → a flat record the rules evaluate against.
+
+    ``programs`` keeps the LAST program_analysis per label (a re-analysis
+    after a shape change supersedes the first); compile/dispatch/phase
+    seconds accumulate over the run. Tolerates partial events (a torn
+    final line parsed into a half-record) by treating missing fields as
+    absent, never raising.
+    """
+    start = next((e for e in events if e.get("event") == "run_start"), {})
+    rec: Dict[str, Any] = {
+        "run_id": start.get("run_id"),
+        "wall_time": start.get("wall_time"),
+        "git_sha": start.get("git_sha"),
+        "backend": start.get("backend"),
+        "source": source,
+        "programs": {},
+        "compiles": {},
+        "phases": {},
+        "dispatch": {},
+    }
+    for e in events:
+        kind = e.get("event")
+        if kind == "program_analysis":
+            label = e.get("program") or "(unattributed)"
+            rec["programs"][label] = {
+                k: e[k] for k in (*PROGRAM_METRICS, "hlo_fingerprint")
+                if k in e
+            }
+        elif kind == "compile":
+            label = e.get("program") or "(unattributed)"
+            c = rec["compiles"].setdefault(label, {"seconds": 0.0, "events": 0})
+            try:
+                c["seconds"] += float(e.get("seconds", 0.0))
+            except (TypeError, ValueError):
+                continue
+            c["events"] += 1
+        elif kind == "phase":
+            name = e.get("name") or "?"
+            p = rec["phases"].setdefault(name, {"seconds": 0.0, "calls": 0})
+            try:
+                p["seconds"] += float(e.get("seconds", 0.0))
+            except (TypeError, ValueError):
+                continue
+            p["calls"] += 1
+        elif kind == "program_call":
+            label = e.get("program") or "(unattributed)"
+            try:
+                rec["dispatch"][label] = rec["dispatch"].get(label, 0.0) + float(
+                    e.get("dispatch_s", 0.0)
+                )
+            except (TypeError, ValueError):
+                continue
+    return rec
+
+
+def _rule_values(record: Dict[str, Any], rule: RegressionRule) -> Dict[str, float]:
+    """{label: value} for one rule's metric over one extracted run."""
+    out: Dict[str, float] = {}
+    if rule.kind == "program":
+        for label, m in record.get("programs", {}).items():
+            if rule.metric in m:
+                out[label] = float(m[rule.metric])
+    elif rule.kind == "compile":
+        for label, c in record.get("compiles", {}).items():
+            out[label] = float(c.get("seconds", 0.0))
+    elif rule.kind == "phase":
+        for name, p in record.get("phases", {}).items():
+            out[name] = float(p.get("seconds", 0.0))
+    elif rule.kind == "dispatch":
+        out = {k: float(v) for k, v in record.get("dispatch", {}).items()}
+    if rule.programs is not None:
+        out = {k: v for k, v in out.items() if k in rule.programs}
+    return out
+
+
+def evaluate_rules(
+    base: Dict[str, Any],
+    new: Dict[str, Any],
+    rules: Sequence[RegressionRule] = DEFAULT_RULES,
+) -> Dict[str, Any]:
+    """Evaluate every rule over two extracted runs.
+
+    Returns ``{"verdicts": [...], "regressions": [...], "pass": bool}``.
+    Each verdict: rule name, kind, program, metric, base/new values, the
+    percent delta, ``regressed``, and (for program-kind rules) whether the
+    HLO fingerprint changed — a fingerprint change turns a would-be
+    regression into context ("XLA built a different program"), but the
+    verdict still flags it: an intentional program change should land with
+    an updated baseline, not a silent pass.
+    """
+    verdicts: List[Dict[str, Any]] = []
+    base_progs = base.get("programs", {})
+    new_progs = new.get("programs", {})
+    for rule in rules:
+        bvals = _rule_values(base, rule)
+        nvals = _rule_values(new, rule)
+        for label in sorted(set(bvals) & set(nvals)):
+            b, n = bvals[label], nvals[label]
+            delta = n - b
+            delta_pct = (n / b - 1.0) * 100.0 if b else (0.0 if not n else float("inf"))
+            regressed = delta_pct > rule.threshold_pct and abs(delta) >= rule.min_abs
+            v: Dict[str, Any] = {
+                "rule": rule.name,
+                "kind": rule.kind,
+                "program": label,
+                "metric": rule.metric,
+                "base": b,
+                "new": n,
+                "delta_pct": round(delta_pct, 2) if delta_pct != float("inf") else None,
+                "regressed": regressed,
+            }
+            if rule.kind == "program":
+                fp_b = base_progs.get(label, {}).get("hlo_fingerprint")
+                fp_n = new_progs.get(label, {}).get("hlo_fingerprint")
+                if fp_b and fp_n:
+                    v["fingerprint_changed"] = fp_b != fp_n
+            verdicts.append(v)
+    regressions = [v for v in verdicts if v["regressed"]]
+    return {"verdicts": verdicts, "regressions": regressions,
+            "pass": not regressions}
+
+
+class RunHistory:
+    """Chronologically-ordered extracted runs from a directory of ledgers.
+
+    Ordering: ``run_start.wall_time`` (ISO-8601, lexicographically
+    sortable) with file mtime as the tiebreak/fallback for torn heads that
+    lost their run_start line.
+    """
+
+    def __init__(self, runs: List[Dict[str, Any]]):
+        self.runs = runs
+
+    @classmethod
+    def scan(cls, directory: str, pattern: str = "*.jsonl") -> "RunHistory":
+        keyed = []
+        for path in sorted(glob.glob(os.path.join(directory, pattern))):
+            try:
+                events = read_ledger(path)
+                mtime = os.path.getmtime(path)
+            except OSError:
+                continue
+            for i, run_events in enumerate(split_runs(events)):
+                rec = extract_run(run_events, source=path)
+                keyed.append(((rec.get("wall_time") or "", mtime, i), rec))
+        keyed.sort(key=lambda kv: kv[0])
+        return cls([rec for _, rec in keyed])
+
+    @classmethod
+    def from_ledger(cls, path: str) -> "RunHistory":
+        return cls([
+            extract_run(run_events, source=path)
+            for run_events in split_runs(read_ledger(path))
+        ])
+
+    def __len__(self) -> int:
+        return len(self.runs)
+
+    def latest(self) -> Optional[Dict[str, Any]]:
+        return self.runs[-1] if self.runs else None
+
+    def series(self, metric: str, kind: str = "program",
+               ) -> Dict[Tuple[str, Optional[str]], List[Tuple[Optional[str], float]]]:
+        """Metric series keyed by ``(label, hlo_fingerprint)`` — program-kind
+        series split when XLA rebuilt the program differently (non-program
+        kinds key on ``(label, None)``). Values are ``(run_id, value)`` in
+        run order."""
+        rule = RegressionRule(metric, kind=kind)
+        out: Dict[Tuple[str, Optional[str]], List[Tuple[Optional[str], float]]] = {}
+        for rec in self.runs:
+            vals = _rule_values(rec, rule)
+            for label, v in vals.items():
+                fp = (rec.get("programs", {}).get(label, {}).get("hlo_fingerprint")
+                      if kind == "program" else None)
+                out.setdefault((label, fp), []).append((rec.get("run_id"), v))
+        return out
+
+    def baseline_for(self, new: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        """The most recent prior run that shares ≥1 program label with
+        ``new`` (so a ledger from an unrelated tool doesn't become the
+        baseline); falls back to the most recent prior run."""
+        labels = set(new.get("programs", {})) | set(new.get("phases", {}))
+        prior = [r for r in self.runs
+                 if r is not new and r.get("run_id") != new.get("run_id")]
+        for rec in reversed(prior):
+            shared = labels & (set(rec.get("programs", {}))
+                               | set(rec.get("phases", {})))
+            if shared:
+                return rec
+        return prior[-1] if prior else None

@@ -1,0 +1,179 @@
+"""Compiled-program cost/memory introspection.
+
+XLA's own analyses of a compiled executable are deterministic and available
+on EVERY backend — including CPU, where the TPU may be down (the round-4/5
+failure class that left whole rounds evidence-free). This module mines a
+jitted program's lowered/compiled artifact for:
+
+  * ``cost_analysis()`` — flops, bytes accessed, transcendentals: what the
+    optimized program *computes*, independent of wall-clock health;
+  * ``memory_analysis()`` — argument/output/temp/generated-code bytes,
+    folded into a ``peak_hbm_bytes`` estimate (arguments + outputs + temps +
+    generated code − aliased/donated bytes) that the run_videop2p HBM gate
+    and the ledger's ``memory`` snapshots can check predicted-vs-actual
+    against;
+  * a stable optimized-HLO fingerprint (sha256 of the HLO text with the
+    nondeterministic ``metadata={...}`` annotations stripped) — two runs of
+    the same program produce the same fingerprint, and a *changed*
+    fingerprint marks "XLA built a different program" across runs;
+  * an instruction-category histogram of the optimized HLO (fusion / dot /
+    convolution / custom-call / copy counts — the op-family view
+    docs/PERF_ANALYSIS.md tabulates from device traces, but available
+    without hardware).
+
+Everything is emitted as one flat ``program_analysis`` record
+(:func:`analysis_record` keys are schema-stable — ``obs/history.py`` keys
+its regression rules on them). All entry points degrade to ``None`` rather
+than raise: introspection must never take a run down.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+from typing import Any, Dict, Optional
+
+import jax
+
+__all__ = [
+    "analyze_compiled",
+    "analyze_jitted",
+    "hlo_fingerprint",
+    "instruction_histogram",
+    "abstractify_args",
+    "PROGRAM_METRICS",
+]
+
+# the numeric metric keys a program_analysis record carries (history rules
+# reference these names; keep in sync with analyze_compiled)
+PROGRAM_METRICS = (
+    "flops",
+    "transcendentals",
+    "bytes_accessed",
+    "argument_bytes",
+    "output_bytes",
+    "temp_bytes",
+    "alias_bytes",
+    "generated_code_bytes",
+    "peak_hbm_bytes",
+    "hlo_instructions",
+)
+
+_METADATA_RE = re.compile(r",?\s*metadata=\{[^}]*\}")
+# one optimized-HLO instruction: `%name = type[...] opcode(...`
+_INSTR_RE = re.compile(r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*\S+\s+([\w\-]+)\(",
+                       re.MULTILINE)
+
+
+def hlo_fingerprint(hlo_text: str) -> str:
+    """Stable 16-hex-char fingerprint of an optimized-HLO module.
+
+    ``metadata={...}`` annotations (op names, source file/line) are the only
+    part of the text that varies with how the program was traced rather
+    than what it computes — strip them, hash the rest. Same program → same
+    fingerprint across processes; a changed fingerprint across runs means
+    XLA built a structurally different executable.
+    """
+    return hashlib.sha256(
+        _METADATA_RE.sub("", hlo_text).encode()
+    ).hexdigest()[:16]
+
+
+def instruction_histogram(hlo_text: str) -> Dict[str, int]:
+    """Optimized-HLO instruction counts by opcode (fusion, dot, copy, ...),
+    sorted descending so the dominant categories lead the record."""
+    counts: Dict[str, int] = {}
+    for m in _INSTR_RE.finditer(hlo_text):
+        op = m.group(1)
+        counts[op] = counts.get(op, 0) + 1
+    return dict(sorted(counts.items(), key=lambda kv: (-kv[1], kv[0])))
+
+
+def _num(v) -> float:
+    """Cost-analysis values arrive as floats; keep integral ones as ints so
+    the JSONL record (and its diffs) read naturally."""
+    f = float(v)
+    return int(f) if f == int(f) else f
+
+
+def analyze_compiled(compiled) -> Dict[str, Any]:
+    """Mine one ``jax.stages.Compiled`` executable into a flat record.
+
+    Each constituent analysis is independently guarded: a backend that
+    cannot produce one of them (e.g. no ``as_text`` on some plugin
+    runtimes) yields a record missing those keys, not an exception.
+
+    Conventions (disclosed in docs/PERF_ANALYSIS.md): flops/bytes are
+    XLA's STATIC per-module counts — ``while``/``scan`` trip counts are
+    not multiplied in — and the memory analysis describes the analyzed
+    backend's schedule. Both are deterministic for a given program and
+    backend, which is the property the cross-run diff needs; neither is a
+    wall-clock predictor.
+    """
+    rec: Dict[str, Any] = {}
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        rec["flops"] = _num(cost.get("flops", 0.0))
+        rec["transcendentals"] = _num(cost.get("transcendentals", 0.0))
+        rec["bytes_accessed"] = _num(cost.get("bytes accessed", 0.0))
+    except Exception:  # noqa: BLE001 — introspection is best-effort
+        pass
+    try:
+        mem = compiled.memory_analysis()
+        arg = int(mem.argument_size_in_bytes)
+        out = int(mem.output_size_in_bytes)
+        tmp = int(mem.temp_size_in_bytes)
+        alias = int(mem.alias_size_in_bytes)
+        code = int(mem.generated_code_size_in_bytes)
+        rec.update(
+            argument_bytes=arg,
+            output_bytes=out,
+            temp_bytes=tmp,
+            alias_bytes=alias,
+            generated_code_bytes=code,
+            # aliased (donated) bytes are counted in both arguments and
+            # outputs but occupy HBM once — subtract one copy
+            peak_hbm_bytes=arg + out + tmp + code - alias,
+        )
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        text = compiled.as_text()
+        hist = instruction_histogram(text)
+        rec["hlo_fingerprint"] = hlo_fingerprint(text)
+        rec["hlo_instructions"] = sum(hist.values())
+        rec["hlo_histogram"] = hist
+    except Exception:  # noqa: BLE001
+        pass
+    return rec
+
+
+def abstractify_args(args, kwargs):
+    """Array leaves → ShapeDtypeStructs (so a later ``.lower()`` never
+    touches possibly-donated/deleted buffers); everything else unchanged."""
+
+    def to_abstract(leaf):
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype)
+        return leaf
+
+    return (jax.tree.map(to_abstract, args),
+            jax.tree.map(to_abstract, kwargs))
+
+
+def analyze_jitted(jitted, *args, **kwargs) -> Optional[Dict[str, Any]]:
+    """Lower + compile ``jitted`` at the given (possibly abstract) arguments
+    and return :func:`analyze_compiled`'s record, or None on any failure.
+
+    This is the ahead-of-time path (``jit(f).lower(...).compile()``) — the
+    executable is built but NEVER executed, which is what makes the whole
+    analysis CPU-runnable while the accelerator is down. With a persistent
+    compilation cache active (both CLIs and bench enable one) the backend
+    compile behind an already-executed program is a cache hit.
+    """
+    try:
+        return analyze_compiled(jitted.lower(*args, **kwargs).compile())
+    except Exception:  # noqa: BLE001
+        return None

@@ -44,6 +44,8 @@ __all__ = [
     "program_label",
     "instrumented_jit",
     "read_ledger",
+    "analysis_enabled",
+    "suppress_compile_events",
 ]
 
 # the active-ledger stack: CLI/bench push one ledger for the whole run;
@@ -56,8 +58,24 @@ _PROGRAM: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
     "videop2p_obs_program", default=None
 )
 
+# set while the AOT introspection compile runs: those backend-compile events
+# describe the ANALYSIS recompile (a persistent-cache hit in practice), not
+# the run's own work — recording them would double bench's compile totals
+_SUPPRESS_COMPILE: contextvars.ContextVar[bool] = contextvars.ContextVar(
+    "videop2p_obs_suppress_compile", default=False
+)
+
 _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 _LISTENER_INSTALLED = False
+
+# kill-switch for the automatic compiled-program introspection (the AOT
+# lower+compile behind every instrumented cache miss); the CLIs expose it
+# as --no_program_analysis
+_ANALYSIS_ENV = "VIDEOP2P_OBS_NO_ANALYSIS"
+
+
+def analysis_enabled() -> bool:
+    return os.environ.get(_ANALYSIS_ENV, "0") != "1"
 
 
 def current_ledger() -> Optional["RunLedger"]:
@@ -79,6 +97,18 @@ def program_label(name: str) -> Iterator[None]:
         _PROGRAM.reset(token)
 
 
+@contextlib.contextmanager
+def suppress_compile_events() -> Iterator[None]:
+    """Compile events fired inside this block are NOT recorded — for AOT
+    introspection recompiles that would otherwise double a run's compile
+    totals (obs.introspect / bench's program analyses)."""
+    token = _SUPPRESS_COMPILE.set(True)
+    try:
+        yield
+    finally:
+        _SUPPRESS_COMPILE.reset(token)
+
+
 def _install_compile_listener() -> None:
     """Register ONE process-wide jax.monitoring listener that forwards
     backend-compile durations to the active ledger. jax 0.4.x has no
@@ -89,7 +119,7 @@ def _install_compile_listener() -> None:
         return
 
     def on_duration(event: str, duration: float, **kw) -> None:
-        if event != _COMPILE_EVENT:
+        if event != _COMPILE_EVENT or _SUPPRESS_COMPILE.get():
             return
         led = current_ledger()
         if led is not None:
@@ -195,6 +225,11 @@ class RunLedger:
     def telemetry(self, program: str, record: Dict[str, Any]) -> None:
         self.event("telemetry", program=program, **record)
 
+    def program_analysis(self, program: str, record: Dict[str, Any]) -> None:
+        """Record one compiled-program introspection record
+        (obs.introspect.analyze_compiled/analyze_jitted) for ``program``."""
+        self.event("program_analysis", program=program, **record)
+
     def _on_compile(self, seconds: float, program: Optional[str]) -> None:
         self.compile_seconds.append(float(seconds))
         self.event("compile", seconds=round(float(seconds), 4),
@@ -271,15 +306,57 @@ class RunLedger:
             pass
 
 
-def instrumented_jit(fun, *, program: str, **jit_kwargs):
+def _analyze_into_ledger(led: "RunLedger", jitted, program: str,
+                         abstract_args, abstract_kwargs) -> None:
+    """Mine the program XLA just built (cost/memory analysis, HLO
+    fingerprint, instruction histogram) into a ``program_analysis`` event.
+
+    Runs the AOT ``lower(...).compile()`` path on ABSTRACT arguments — the
+    executed call may have donated its buffers — with compile-event
+    recording suppressed (the recompile is a persistent-cache hit wherever
+    a cache is configured; either way it is not the run's own compile
+    work). Best-effort: any failure leaves the ledger without the event,
+    never breaks the call that triggered it.
+    """
+    from videop2p_tpu.obs import introspect
+
+    with suppress_compile_events():
+        rec = introspect.analyze_jitted(
+            jitted, *abstract_args, **abstract_kwargs
+        )
+    if rec:
+        led.program_analysis(program, rec)
+
+
+def _multi_device(tree) -> bool:
+    """True when any array leaf is sharded across >1 device — abstract
+    re-lowering would then build a DIFFERENT (unsharded) program, so the
+    automatic analysis skips rather than mis-report."""
+    for leaf in jax.tree.leaves(tree):
+        sharding = getattr(leaf, "sharding", None)
+        try:
+            if sharding is not None and len(sharding.device_set) > 1:
+                return True
+        except Exception:  # noqa: BLE001
+            continue
+    return False
+
+
+def instrumented_jit(fun, *, program: str, analyze: bool = True, **jit_kwargs):
     """``jax.jit`` plus ledger instrumentation.
 
     Each call through the wrapper records a ``program_call`` event with the
     program label, whether the call MISSED the jit cache (compiled), and
     the dispatch wall-clock; compile events fired inside the call are
-    attributed to the label. With no active ledger the wrapper adds one
-    attribute lookup and nothing else — the jitted callable is returned
-    straight through.
+    attributed to the label. On a cache miss (with ``analyze=True``, the
+    default) the freshly-built executable is additionally mined into a
+    ``program_analysis`` event — XLA's cost/memory analysis, a stable
+    optimized-HLO fingerprint, and an instruction histogram
+    (obs/introspect.py) — which is what ``obs/history.py`` and
+    ``tools/obs_diff.py`` diff across runs. Disable process-wide with
+    ``VIDEOP2P_OBS_NO_ANALYSIS=1`` (the CLIs' ``--no_program_analysis``).
+    With no active ledger the wrapper adds one attribute lookup and
+    nothing else — the jitted callable is returned straight through.
     """
     jitted = jax.jit(fun, **jit_kwargs)
 
@@ -291,6 +368,15 @@ def instrumented_jit(fun, *, program: str, **jit_kwargs):
             before = jitted._cache_size()
         except Exception:  # noqa: BLE001 — private API; degrade gracefully
             before = None
+        want_analysis = analyze and before is not None and analysis_enabled()
+        if want_analysis:
+            # abstractify BEFORE the call: donated buffers are deleted by it
+            from videop2p_tpu.obs.introspect import abstractify_args
+
+            try:
+                abs_args, abs_kwargs = abstractify_args(args, kwargs)
+            except Exception:  # noqa: BLE001
+                want_analysis = False
         t0 = time.perf_counter()
         with program_label(program):
             out = jitted(*args, **kwargs)
@@ -303,6 +389,11 @@ def instrumented_jit(fun, *, program: str, **jit_kwargs):
                 miss = None
         led.event("program_call", program=program, cache_miss=miss,
                   dispatch_s=round(dt, 4))
+        if miss and want_analysis and not _multi_device((args, kwargs)):
+            try:
+                _analyze_into_ledger(led, jitted, program, abs_args, abs_kwargs)
+            except Exception:  # noqa: BLE001 — observability never kills a run
+                pass
         return out
 
     wrapper._jitted = jitted  # escape hatch (lower/compile introspection)

@@ -499,7 +499,13 @@ def null_text_optimization(
         def chunk_fn(p, cond, small_carry, chunk_xs):
             return jax.lax.scan(make_body(p, cond), small_carry, chunk_xs)
 
-        chunk_scan = jax.jit(chunk_fn)
+        # instrumented: with an active ledger each chunk dispatch records a
+        # program_call, and the compile (first chunk) is mined into a
+        # program_analysis event (obs/introspect.py); with no ledger this
+        # is jax.jit plus one attribute lookup per call
+        from videop2p_tpu.obs.ledger import instrumented_jit
+
+        chunk_scan = instrumented_jit(chunk_fn, program="null_text_chunked")
         _cache_put(_CHUNK_SCAN_CACHE, _CHUNK_SCAN_CACHE_MAX, cache_key, chunk_scan)
     small = (x_t, uncond_embedding, key)
     piece_lists = None
@@ -618,9 +624,16 @@ def null_text_optimization_fused(
             )
 
         # argnum 2 = the trajectory, the only buffer worth donating (the
-        # uncond embedding is KB-scale and callers routinely reuse theirs)
-        program = jax.jit(
-            program_fn, donate_argnums=(2,) if donate else ()
+        # uncond embedding is KB-scale and callers routinely reuse theirs).
+        # instrumented_jit: the fused program jits inside this cache where
+        # the CLI's wrappers cannot reach it — instrumenting HERE is what
+        # lands its program_call / program_analysis ledger events (the
+        # analysis abstracts its arguments first, so donation is safe)
+        from videop2p_tpu.obs.ledger import instrumented_jit
+
+        program = instrumented_jit(
+            program_fn, program="null_text_fused",
+            donate_argnums=(2,) if donate else ()
         )
         _cache_put(_FUSED_PROGRAM_CACHE, _FUSED_PROGRAM_CACHE_MAX,
                    cache_key, program)
