@@ -340,6 +340,93 @@ def test_ledger_bench_fields_schema(bench):
     assert set(empty) == set(rec)
 
 
+def _import_roots(path):
+    """Every imported top-level module name in a file, comprehensions and
+    function bodies included (AST walk — lazy imports don't hide)."""
+    import ast
+
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    roots = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            roots.update(a.name.split(".")[0] for a in node.names)
+        elif isinstance(node, ast.ImportFrom) and node.module and not node.level:
+            roots.add(node.module.split(".")[0])
+    return roots
+
+
+def test_report_and_obs_import_only_stdlib_numpy_jax():
+    """CI satellite (ISSUE 4): tools/edit_report.py and videop2p_tpu/obs/
+    must import only stdlib + numpy + jax (+ the package itself) — no
+    matplotlib/PIL/imageio-only paths — so the report renders and the obs
+    stack decodes on any box, plotting stack or not."""
+    import sys
+
+    allowed = set(sys.stdlib_module_names) | {"numpy", "jax", "videop2p_tpu"}
+    banned = {"matplotlib", "PIL", "imageio", "cv2", "torch", "torchvision",
+              "pandas", "seaborn", "plotly", "scipy", "skimage",
+              "tensorflow", "flax", "optax", "transformers"}
+    files = [os.path.join(_REPO, "tools", "edit_report.py")]
+    obs_dir = os.path.join(_REPO, "videop2p_tpu", "obs")
+    files += [os.path.join(obs_dir, f) for f in sorted(os.listdir(obs_dir))
+              if f.endswith(".py")]
+    offenders = []
+    for path in files:
+        roots = _import_roots(path)
+        for r in sorted(roots):
+            if r in banned or r not in allowed:
+                offenders.append(f"{path}: imports {r!r}")
+    assert not offenders, (
+        "stdlib+numpy+jax-only import contract violated:\n"
+        + "\n".join(offenders)
+    )
+
+
+def test_quality_and_attn_ledger_event_schema(tmp_path):
+    """Schema pin (ISSUE 4): the new `quality` and `attn_maps` ledger
+    events carry their documented field sets — the report, the regression
+    rules and ledger_summary all key on these names."""
+    import numpy as np
+
+    from videop2p_tpu.obs import RunLedger, read_ledger
+    from videop2p_tpu.obs.attention import (
+        ATTN_SUMMARY_FIELDS,
+        summarize_attn_record,
+    )
+    from videop2p_tpu.obs.quality import (
+        QUALITY_SUMMARY_FIELDS,
+        edit_quality_record,
+    )
+
+    frames = np.random.RandomState(0).rand(2, 8, 8, 3).astype(np.float32)
+    summary, curves = edit_quality_record(frames, frames, frames,
+                                          mask=np.ones((2, 8, 8)))
+    attn_summary = summarize_attn_record({
+        "cross_heat": np.zeros((3, 1, 16, 16, 77), np.float32),
+        "entropy": {"b/attn2": np.zeros(3)},
+        "mask_cov": np.zeros((3, 2, 2)),
+        "blend_active": np.zeros(3, np.int64),
+    })
+    path = str(tmp_path / "ledger.jsonl")
+    with RunLedger(path) as led:
+        led.event("quality", program="edit_quality", sidecar="sc.npz",
+                  **summary)
+        led.event("attn_maps", scope="edit", program="attn_edit",
+                  sidecar="sc.npz", streams=[1], words=[], **attn_summary)
+    by_kind = {e["event"]: e for e in read_ledger(path)}
+    q = by_kind["quality"]
+    assert set(QUALITY_SUMMARY_FIELDS) <= set(q)
+    assert {"program", "sidecar", "background_psnr", "mask_coverage"} <= set(q)
+    a = by_kind["attn_maps"]
+    assert set(ATTN_SUMMARY_FIELDS) <= set(a)
+    assert {"scope", "program", "sidecar", "streams", "words",
+            "mask_cov_final", "blend_active_steps"} <= set(a)
+    assert a["steps"] == 3 and a["sites"] == ["b/attn2"]
+    # per-frame curves exist for the sidecar side of the contract
+    assert {"recon_psnr_frames", "background_psnr_frames"} <= set(curves)
+
+
 def test_no_wall_clock_in_timed_regions():
     """Satellite guard (ISSUE 2): every timed region in the package uses
     the monotonic clock — ``time.time()`` steps under NTP adjustment and

@@ -120,6 +120,38 @@ def test_reweight_scales_words():
     np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-7)
 
 
+def test_equalizer_rejects_unmatched_word():
+    """Satellite (ISSUE 4): a word that tokenizes to no position used to
+    no-op silently (``eq[:, []] = val``) — the requested reweight never
+    happened. Now it raises with the word in the message."""
+    import pytest
+
+    t = WordTokenizer()
+    with pytest.raises(ValueError, match="'unicorn'"):
+        get_equalizer("a rabbit jumping", ["unicorn"], [4.0], t)
+    # the controller surface propagates the same failure
+    with pytest.raises(ValueError, match="'unicorn'"):
+        make_controller(
+            ["a rabbit jumping", "a origami rabbit jumping"], t, STEPS,
+            is_replace_controller=False, cross_replace_steps=1.0,
+            self_replace_steps=0.5,
+            equalizer_params={"words": ["unicorn"], "values": [4.0]},
+        )
+
+
+def test_equalizer_rejects_length_mismatch():
+    """Satellite (ISSUE 4): ``zip(words, values)`` used to silently
+    truncate a words/values length mismatch."""
+    import pytest
+
+    t = WordTokenizer()
+    with pytest.raises(ValueError, match="length mismatch"):
+        get_equalizer("a origami rabbit", ["origami", "rabbit"], [4.0], t)
+    # scalar-vs-string normalization still works symmetrically
+    eq = get_equalizer("a origami rabbit", "origami", 4.0, t)
+    assert eq[0, 2] == 4.0
+
+
 def test_temporal_replace_window():
     ctx, _ = _ctx(self_replace_steps=0.5)  # active for steps [0, 5)
     D = 4

@@ -321,7 +321,36 @@ def test_phase_timer_emits_into_active_ledger(tmp_path, capsys):
     assert phase_records() == {}
 
 
-def test_phase_records_thread_safe():
+def test_trace_emits_ledger_event(tmp_path, monkeypatch):
+    """Satellite (ISSUE 4): utils.profiling.trace captures a device trace
+    when VIDEOP2P_TRACE_DIR is set but the ledger never learned the path —
+    now a ``trace`` event (name + directory) links it to the run."""
+    import contextlib
+
+    from videop2p_tpu.utils.profiling import trace
+
+    traced = []
+    monkeypatch.setattr(
+        jax.profiler, "trace",
+        lambda d: (traced.append(d), contextlib.nullcontext())[1],
+    )
+    monkeypatch.setenv("VIDEOP2P_TRACE_DIR", str(tmp_path / "traces"))
+    path = str(tmp_path / "ledger.jsonl")
+    with RunLedger(path):
+        with trace("edit_phase"):
+            pass
+    events = read_ledger(path)
+    trace_evs = [e for e in events if e["event"] == "trace"]
+    assert len(trace_evs) == 1
+    assert trace_evs[0]["name"] == "edit_phase"
+    assert trace_evs[0]["trace_dir"] == str(tmp_path / "traces" / "edit_phase")
+    assert traced == [str(tmp_path / "traces" / "edit_phase")]
+    # the phase event still lands alongside it
+    assert any(e["event"] == "phase" and e["name"] == "edit_phase"
+               for e in events)
+    # no ledger active: the same region is trace+phase only, no crash
+    with trace("unledgered"):
+        pass
     from videop2p_tpu.utils.profiling import phase_records, phase_timer, reset
 
     reset()
@@ -439,6 +468,35 @@ def test_sparkline_handles_degenerate_series():
     assert sparkline([1.0, 1.0, 1.0]) == "▄▄▄"
     assert "!" in sparkline([1.0, float("nan"), 2.0])
     assert len(sparkline(list(range(500)), width=50)) == 50
+    # inf values render as '!' too; an all-non-finite series is all '!'
+    assert sparkline([1.0, float("inf"), 2.0])[1] == "!"
+    assert sparkline([float("nan"), float("inf")]) == "!!"
+
+
+def test_decode_helpers_degenerate_inputs():
+    """Satellite (ISSUE 4): the decode helpers must survive empty stats
+    trees, zero-length curves, and NaN/inf VALUES (not just counts) — a
+    killed run's partial telemetry still has to land in the ledger."""
+    assert decode_step_stats({}) == []
+    assert summarize_step_stats({}) == {"steps": 0}
+    empty = {"abs_max": np.zeros((0,)), "mean": np.zeros((0,))}
+    assert decode_step_stats(empty) == []
+    assert summarize_step_stats(empty) == {"steps": 0}
+
+    weird = {
+        "abs_max": np.array([1.0, np.nan, np.inf]),
+        "mean": np.array([0.0, np.nan, 5.0]),
+        "nan_count": np.array([0, 1, 0]),
+        "inf_count": np.array([0, 0, 1]),
+    }
+    recs = decode_step_stats(weird)
+    assert [r["step"] for r in recs] == [0, 1, 2]
+    assert np.isnan(recs[1]["abs_max"]) and recs[2]["abs_max"] == np.inf
+    s = summarize_step_stats(weird)
+    assert s["steps"] == 3
+    assert s["nan_total"] == 1 and s["first_nan_step"] == 1
+    assert s["inf_total"] == 1 and s["first_inf_step"] == 2
+    assert s["mean_final"] == 5.0
 
 
 def test_ledger_summary_tolerates_empty_and_truncated(tmp_path, capsys):

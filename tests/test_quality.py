@@ -1,0 +1,558 @@
+"""Semantic observability (ISSUE 4): in-program attention capture,
+edit-quality metrics, and the self-contained HTML run report.
+
+CPU gates for the tentpole's contracts:
+
+  * PSNR/SSIM pinned against closed forms (identical → inf / 1.0, a
+    known constant-offset delta → the exact dB figure);
+  * capture-off bit-exactness: ``edit_sample`` and ``cached_fast_edit``
+    with ``attn_maps=False`` produce byte-identical outputs to the
+    capture-on primary outputs — the cached replay's ``src_err == 0.0``
+    included — and the capture record has the documented fixed shapes;
+  * ``blend_mask`` is exactly the mask ``local_blend`` applies;
+  * the quality ``RegressionRule``s (direction="decrease") flag PSNR
+    drops, pass inf→inf, and flag inf→finite;
+  * the report renders per-word heatmap grids, mask overlays, the
+    quality table and verdicts from a ledger + sidecar, embedded as data
+    URIs — numpy+stdlib only.
+
+Fake attention-sowing denoisers keep everything eager-CPU-fast; the
+full-pipeline CLI e2e (tiny models, --attn_maps --quality --report) is
+the slow-marked acceptance test at the bottom.
+"""
+
+import importlib.util
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from videop2p_tpu.core import DDIMScheduler
+from videop2p_tpu.obs.attention import (
+    attn_step_record,
+    cross_attention_heat,
+    load_obs_sidecar,
+    save_obs_sidecar,
+    site_entropies,
+    summarize_attn_record,
+)
+from videop2p_tpu.obs.quality import (
+    QUALITY_SUMMARY_FIELDS,
+    adjacent_frame_psnr,
+    edit_quality_record,
+    masked_psnr,
+    psnr,
+    ssim,
+)
+from videop2p_tpu.pipelines import cached_fast_edit, ddim_inversion, edit_sample
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+STEPS = 4
+SHAPE = (1, 2, 8, 8, 4)  # (B, F, h, w, C) — 8×8 latent, 2 frames
+TEXT_LEN = 77
+
+
+@pytest.fixture(scope="module")
+def sched():
+    return DDIMScheduler.create_sd()
+
+
+def attn_unet():
+    """Fake denoiser that sows head-mean maps the way the real UNet does:
+    one cross site (B·F, h·w, 77) and one temporal site (B·N, F, F), both
+    mildly input-dependent so the capture is not a constant."""
+
+    def fn(params, sample, t, text, control=None):
+        b, f, h, w, _ = sample.shape
+        L = text.shape[-2]
+        wiggle = 1e-3 * jnp.mean(jnp.abs(sample))
+        probs = jnp.full((b * f, h * w, L), 1.0 / L) + wiggle
+        tprobs = jnp.full((b * h * w, f, f), 1.0 / f)
+        store = {
+            "attn_store": {
+                "blocks_0": {"attn2": {"maps": (probs,)},
+                             "attn_temp": {"maps": (tprobs,)}}
+            },
+            "attn_base": {},
+        }
+        bias = jnp.mean(text, axis=(1, 2))
+        return 0.1 * sample + bias[:, None, None, None, None], store
+
+    return fn
+
+
+@pytest.fixture(scope="module")
+def problem(sched):
+    fn = attn_unet()
+    x0 = jax.random.normal(jax.random.key(0), SHAPE)
+    cond = 0.3 * jnp.ones((1, TEXT_LEN, 8))
+    cond2 = jnp.concatenate([cond, 0.5 * jnp.ones((1, TEXT_LEN, 8))], axis=0)
+    uncond = jnp.zeros((TEXT_LEN, 8))
+    traj = ddim_inversion(fn, None, sched, x0, cond, num_inference_steps=STEPS)
+    return fn, x0, cond, cond2, uncond, traj
+
+
+# ------------------------------------------------------- quality metrics --
+
+
+def test_psnr_closed_forms():
+    a = np.random.RandomState(0).rand(3, 16, 16, 3).astype(np.float32)
+    assert float(psnr(a, a)) == float("inf")
+    # constant offset c: MSE = c², PSNR = −20·log10(c) exactly
+    assert float(psnr(a, a + 0.1)) == pytest.approx(20.0, abs=1e-3)
+    assert float(psnr(a, a + 0.01)) == pytest.approx(40.0, abs=1e-2)
+    # data_range scales the peak
+    assert float(psnr(a * 255, a * 255 + 25.5, data_range=255.0)) == (
+        pytest.approx(20.0, abs=1e-3)
+    )
+
+
+def test_ssim_closed_forms_and_monotonicity():
+    a = np.random.RandomState(1).rand(2, 20, 20, 3).astype(np.float32)
+    assert float(ssim(a, a)) == pytest.approx(1.0, abs=1e-6)
+    rng = np.random.RandomState(2)
+    small = a + 0.01 * rng.randn(*a.shape).astype(np.float32)
+    large = a + 0.10 * rng.randn(*a.shape).astype(np.float32)
+    s_small, s_large = float(ssim(a, small)), float(ssim(a, large))
+    assert 0.9 < s_small < 1.0
+    assert s_large < s_small  # more noise, less similar
+
+
+def test_masked_psnr_scores_only_the_weighted_region():
+    a = np.random.RandomState(3).rand(2, 8, 8, 3).astype(np.float32)
+    mask = np.zeros((2, 8, 8), np.float32)
+    mask[:, :4] = 1.0  # "edit region" = top half
+    edited = a.copy()
+    edited[:, :4] += 0.5  # change ONLY inside the mask
+    # background (1 − mask) is untouched → inf
+    assert float(masked_psnr(edited, a, (1.0 - mask)[..., None])) == float("inf")
+    # the edit region itself scores the 0.5 offset: −20·log10(0.5) ≈ 6.02 dB
+    assert float(masked_psnr(edited, a, mask[..., None])) == pytest.approx(
+        6.0206, abs=1e-3
+    )
+    # an all-zero weight has nothing to measure → NaN, not a fake number
+    assert np.isnan(float(masked_psnr(edited, a, np.zeros_like(mask)[..., None])))
+
+
+def test_adjacent_frame_psnr():
+    static = np.ones((4, 8, 8, 3), np.float32) * 0.5
+    assert np.all(np.isinf(np.asarray(adjacent_frame_psnr(static))))
+    flicker = static.copy()
+    flicker[2] += 0.1
+    curve = np.asarray(adjacent_frame_psnr(flicker))
+    assert curve.shape == (3,)
+    # both transitions around the flicker frame read the 0.1 offset (20 dB)
+    assert curve[1] == pytest.approx(20.0, abs=1e-3)
+    assert curve[2] == pytest.approx(20.0, abs=1e-3)
+    assert np.isinf(curve[0])
+
+
+def test_edit_quality_record_schema_and_mask_keys():
+    a = np.random.RandomState(4).rand(3, 16, 16, 3).astype(np.float32)
+    edited = a.copy()
+    mask = np.zeros((3, 16, 16), np.float32)
+    mask[:, :8] = 1.0
+    edited[:, :8] = 1.0 - edited[:, :8]
+    summary, curves = edit_quality_record(a, a, edited, mask=mask)
+    for k in QUALITY_SUMMARY_FIELDS:
+        assert k in summary, k
+    assert summary["recon_psnr"] == float("inf")
+    assert summary["recon_ssim"] == 1.0
+    assert summary["background_psnr"] == float("inf")  # untouched outside mask
+    assert summary["mask_coverage"] == pytest.approx(0.5, abs=1e-6)
+    assert curves["recon_psnr_frames"].shape == (3,)
+    assert curves["background_psnr_frames"].shape == (3,)
+    # no mask → the background keys are absent, the core schema stays
+    summary2, curves2 = edit_quality_record(a, a, edited)
+    assert "background_psnr" not in summary2
+    assert set(QUALITY_SUMMARY_FIELDS) <= set(summary2)
+    assert "background_psnr_frames" not in curves2
+
+
+# ---------------------------------------------------- attention capture --
+
+
+def _fake_store(b_total, q, L, f=2, n=None):
+    probs = jnp.full((b_total, q, L), 1.0 / L)
+    store = {"attn_store": {"blocks_0": {"attn2": {"maps": (probs,)}}},
+             "attn_base": {}}
+    if n is not None:
+        store["attn_store"]["blocks_0"]["attn_temp"] = {
+            "maps": (jnp.full((n, f, f), 1.0 / f),)
+        }
+    return store
+
+
+def test_cross_attention_heat_shapes_and_uniformity():
+    # 2 uncond + 2 cond streams × 2 frames, 8×8 queries
+    store = _fake_store((2 + 2) * 2, 64, TEXT_LEN)
+    heat = cross_attention_heat(
+        store, num_uncond=2, num_cond=2, video_length=2,
+        text_len=TEXT_LEN, latent_hw=(8, 8),
+    )
+    assert heat.shape == (2, 16, 16, TEXT_LEN)
+    # a uniform attention distribution stays uniform through the pooling
+    np.testing.assert_allclose(np.asarray(heat), 1.0 / TEXT_LEN, rtol=1e-5)
+    # no qualifying site → zeros at the same fixed shape, not an error
+    zero = cross_attention_heat(
+        {"attn_store": {}, "attn_base": {}}, num_uncond=2, num_cond=2,
+        video_length=2, text_len=TEXT_LEN, latent_hw=(8, 8),
+    )
+    assert zero.shape == (2, 16, 16, TEXT_LEN)
+    assert float(jnp.abs(zero).max()) == 0.0
+
+
+def test_site_entropies_uniform_is_log_k():
+    store = _fake_store(8, 64, TEXT_LEN, f=2, n=16)
+    ents = site_entropies(store)
+    assert set(ents) == {"blocks_0/attn2", "blocks_0/attn_temp"}
+    assert float(ents["blocks_0/attn2"]) == pytest.approx(np.log(TEXT_LEN), rel=1e-3)
+    assert float(ents["blocks_0/attn_temp"]) == pytest.approx(np.log(2), rel=1e-3)
+
+
+def test_summarize_attn_record_and_sidecar_roundtrip(tmp_path):
+    rec = {
+        "cross_heat": np.random.rand(5, 2, 16, 16, TEXT_LEN).astype(np.float32),
+        "entropy": {"a/attn2": np.linspace(4.0, 4.2, 5)},
+        "mask_cov": np.full((5, 2, 2), 0.25, np.float32),
+        "blend_active": np.array([0, 0, 1, 1, 1]),
+    }
+    s = summarize_attn_record(rec)
+    assert s["steps"] == 5
+    assert s["heat_shape"] == [5, 2, 16, 16, TEXT_LEN]
+    assert s["sites"] == ["a/attn2"]
+    assert s["entropy_mean"]["a/attn2"] == pytest.approx(4.1, abs=1e-3)
+    assert s["mask_cov_final"] == [0.25, 0.25]
+    assert s["blend_active_steps"] == 3
+    path = save_obs_sidecar(str(tmp_path / "sc.npz"),
+                            {"attn_edit/cross_heat": rec["cross_heat"]})
+    back = load_obs_sidecar(path)
+    np.testing.assert_array_equal(back["attn_edit/cross_heat"], rec["cross_heat"])
+
+
+def test_blend_mask_is_exactly_what_local_blend_applies():
+    from videop2p_tpu.control.local_blend import (
+        LocalBlendConfig, blend_mask, local_blend,
+    )
+
+    P, F, S, r, L = 2, 2, 1, 8, TEXT_LEN
+    alpha = np.zeros((P, 1, L), np.float32)
+    alpha[:, :, 2] = 1.0
+    cfg = LocalBlendConfig(alpha_layers=jnp.asarray(alpha), start_blend=1)
+    maps = jax.random.uniform(jax.random.key(5), (P, F, S, r, r, L))
+    x = jax.random.normal(jax.random.key(6), (P, F, 8, 8, 4))
+    mask = blend_mask(maps, cfg, (8, 8))
+    assert mask.shape == (P, F, 8, 8) and mask.dtype == jnp.bool_
+    maskf = mask.astype(x.dtype)[..., None]
+    expect = x[:1] + maskf * (x - x[:1])
+    got = local_blend(x, maps, cfg, jnp.asarray(5))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(expect))
+
+
+# -------------------------------------- capture-off bit-exactness pins --
+
+
+def test_edit_sample_attn_off_is_bit_exact(problem, sched):
+    fn, _, _, cond2, uncond, traj = problem
+    out_off = jax.jit(
+        lambda xt: edit_sample(fn, None, sched, xt, cond2, uncond,
+                               num_inference_steps=STEPS)
+    )(traj[-1])
+    out_on, attn = jax.jit(
+        lambda xt: edit_sample(fn, None, sched, xt, cond2, uncond,
+                               num_inference_steps=STEPS, attn_maps=True)
+    )(traj[-1])
+    assert np.array_equal(np.asarray(out_off), np.asarray(out_on))
+    assert attn["cross_heat"].shape == (STEPS, 2, 16, 16, TEXT_LEN)
+    assert set(attn["entropy"]) == {"blocks_0/attn2", "blocks_0/attn_temp"}
+    for v in attn["entropy"].values():
+        assert v.shape == (STEPS,)
+        assert np.isfinite(np.asarray(v)).all()
+    # telemetry + attn compose in documented order
+    out_both, tel, attn2 = jax.jit(
+        lambda xt: edit_sample(fn, None, sched, xt, cond2, uncond,
+                               num_inference_steps=STEPS, telemetry=True,
+                               attn_maps=True)
+    )(traj[-1])
+    assert np.array_equal(np.asarray(out_off), np.asarray(out_both))
+    assert tel["abs_max"].shape == (STEPS,)
+    np.testing.assert_array_equal(np.asarray(attn2["cross_heat"]),
+                                  np.asarray(attn["cross_heat"]))
+
+
+def test_cached_fast_edit_attn_off_bit_exact_and_replay_exact(problem, sched):
+    fn, x0, cond, cond2, uncond, _ = problem
+    kw = dict(num_inference_steps=STEPS, cross_len=0, self_window=(0, 0))
+    traj_off, edited_off = jax.jit(
+        lambda x: cached_fast_edit(fn, None, sched, x, cond, cond2,
+                                   uncond, None, **kw)
+    )(x0)
+    traj_on, edited_on, attn = jax.jit(
+        lambda x: cached_fast_edit(fn, None, sched, x, cond, cond2,
+                                   uncond, None, attn_maps=True, **kw)
+    )(x0)
+    assert np.array_equal(np.asarray(edited_off), np.asarray(edited_on))
+    assert np.array_equal(np.asarray(traj_off), np.asarray(traj_on))
+    # the capture-on cached replay keeps the src_err == 0.0 guarantee
+    assert float(jnp.max(jnp.abs(edited_on[0] - x0[0]))) == 0.0
+    assert set(attn) == {"inversion", "edit"}
+    # edit batch holds only the E=1 edit stream; inversion the source
+    assert attn["edit"]["cross_heat"].shape == (STEPS, 1, 16, 16, TEXT_LEN)
+    assert attn["inversion"]["cross_heat"].shape == (STEPS, 1, 16, 16, TEXT_LEN)
+
+
+def test_ddim_inversion_attn_off_is_bit_exact(problem, sched):
+    fn, x0, cond, _, _, traj = problem
+    traj_on, attn = ddim_inversion(
+        fn, None, sched, x0, cond, num_inference_steps=STEPS, attn_maps=True
+    )
+    assert np.array_equal(np.asarray(traj), np.asarray(traj_on))
+    assert attn["cross_heat"].shape == (STEPS, 1, 16, 16, TEXT_LEN)
+
+
+# ------------------------------------------------ quality regressions --
+
+
+def _qrec(**quality):
+    return {"run_id": "x", "programs": {}, "compiles": {}, "phases": {},
+            "dispatch": {}, "quality": quality}
+
+
+def test_quality_rules_flag_psnr_drop_and_pass_improvements():
+    from videop2p_tpu.obs import QUALITY_RULES, evaluate_rules
+
+    base = _qrec(recon_psnr=32.0, background_psnr=40.0, recon_ssim=0.98)
+    # a 4 dB reconstruction drop (> 5% and > 0.5 abs) regresses
+    res = evaluate_rules(base, _qrec(recon_psnr=28.0, background_psnr=40.0,
+                                     recon_ssim=0.98), QUALITY_RULES)
+    assert not res["pass"]
+    assert {v["metric"] for v in res["regressions"]} == {"recon_psnr"}
+    # an improvement (or tiny noise) passes
+    assert evaluate_rules(base, _qrec(recon_psnr=33.0, background_psnr=40.1,
+                                      recon_ssim=0.981), QUALITY_RULES)["pass"]
+    # noise-floor: a 0.2 dB wobble is under min_abs even at small bases
+    assert evaluate_rules(_qrec(recon_psnr=3.0), _qrec(recon_psnr=2.8),
+                          QUALITY_RULES)["pass"]
+
+
+def test_quality_rules_inf_semantics():
+    from videop2p_tpu.obs import QUALITY_RULES, evaluate_rules
+
+    inf = float("inf")
+    # bit-exact both runs: clean pass
+    assert evaluate_rules(_qrec(recon_psnr=inf), _qrec(recon_psnr=inf),
+                          QUALITY_RULES)["pass"]
+    # losing the exactness pedestal always regresses
+    res = evaluate_rules(_qrec(recon_psnr=inf), _qrec(recon_psnr=45.0),
+                         QUALITY_RULES)
+    assert not res["pass"]
+    # gaining it is an improvement
+    assert evaluate_rules(_qrec(recon_psnr=45.0), _qrec(recon_psnr=inf),
+                          QUALITY_RULES)["pass"]
+
+
+def test_extract_run_collects_quality_events():
+    from videop2p_tpu.obs import extract_run
+
+    rec = extract_run([
+        {"event": "run_start", "run_id": "q"},
+        {"event": "quality", "program": "edit_quality", "sidecar": "x.npz",
+         "recon_psnr": 30.5, "recon_ssim": 0.97, "note": "text-ignored"},
+    ])
+    assert rec["quality"] == {"recon_psnr": 30.5, "recon_ssim": 0.97}
+
+
+# ------------------------------------------------------------- report --
+
+
+def _report_fixture(tmp_path):
+    events = [
+        {"event": "run_start", "run_id": "rep", "prompt": "a rabbit is jumping",
+         "wall_time": "2026-08-04T00:00:00Z"},
+        {"event": "attn_maps", "scope": "edit", "sidecar": "sc.npz",
+         "streams": [0, 1], "steps": 4,
+         "heat_shape": [4, 2, 16, 16, TEXT_LEN], "sites": ["b/attn2"],
+         "entropy_mean": {"b/attn2": 4.3},
+         "words": [{"prompt": 1, "word": "origami", "tokens": [2]},
+                   {"prompt": 0, "word": "rabbit", "tokens": [2, 3]}]},
+        {"event": "quality", "program": "edit_quality", "recon_psnr": 31.2,
+         "recon_ssim": 0.97, "edit_adjacent_psnr": 28.0,
+         "source_adjacent_psnr": 29.0, "background_psnr": 38.5},
+        {"event": "telemetry", "program": "null_text_fused",
+         "loss_curve": [1.0, 0.5, 0.2], "loss_final": 0.2,
+         "inner_steps_total": 12},
+        {"event": "regression_verdicts", "baseline_run_id": "r0", "pass": False,
+         "verdicts": [{"rule": "quality:recon_psnr-5%", "program": "edit_quality",
+                       "base": 35.0, "new": 31.2, "delta_pct": 10.9,
+                       "regressed": True}],
+         "regressions": [{"rule": "quality:recon_psnr-5%"}]},
+        {"event": "phase", "name": "cached_invert_edit", "seconds": 9.5},
+        {"event": "trace", "name": "edit", "trace_dir": "/tmp/tr/edit"},
+    ]
+    sidecar = {
+        "attn_edit/cross_heat":
+            np.random.RandomState(0).rand(4, 2, 16, 16, TEXT_LEN)
+            .astype(np.float32),
+        "attn_edit/mask_heat":
+            np.random.RandomState(1).rand(4, 2, 3, 16, 16).astype(np.float32),
+        "attn_edit/mask_cov":
+            np.random.RandomState(2).rand(4, 2, 3).astype(np.float32),
+        "frames/edit":
+            (np.random.RandomState(3).rand(3, 24, 24, 3) * 255)
+            .astype(np.uint8),
+    }
+    ledger = str(tmp_path / "ledger.jsonl")
+    with open(ledger, "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+    np.savez_compressed(str(tmp_path / "sc.npz"), **sidecar)
+    return events, sidecar, ledger
+
+
+def test_render_report_sections(tmp_path):
+    from videop2p_tpu.obs.report import render_report
+
+    events, sidecar, _ = _report_fixture(tmp_path)
+    html = render_report(events, sidecar)
+    # per-word heatmaps: both words, embedded PNGs, step labels
+    assert "origami" in html and "rabbit" in html
+    assert html.count("data:image/png;base64,") >= 4
+    # quality table, null-text sparkline, verdicts, phases, trace link
+    assert "recon_psnr" in html and "Edit quality" in html
+    assert "Null-text" in html and "<svg" in html
+    assert "REGRESSIONS" in html and "quality:recon_psnr-5%" in html
+    assert "cached_invert_edit" in html
+    assert "/tmp/tr/edit" in html
+    # mask overlay section present (mask_heat + frames/edit in sidecar)
+    assert "LocalBlend mask" in html
+
+
+def test_edit_report_tool_cli(tmp_path, capsys):
+    spec = importlib.util.spec_from_file_location(
+        "edit_report_under_test", os.path.join(_REPO, "tools", "edit_report.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    _, _, ledger = _report_fixture(tmp_path)
+    out = str(tmp_path / "rep.html")
+    assert mod.main(["edit_report.py", ledger, "-o", out,
+                     "--sidecar", str(tmp_path / "sc.npz")]) == 0
+    assert os.path.isfile(out)
+    html = open(out).read()
+    assert "origami" in html and "data:image/png;base64," in html
+    # sidecar auto-discovery: the event's basename resolves next to the ledger
+    out2 = str(tmp_path / "rep2.html")
+    assert mod.main(["edit_report.py", ledger, "-o", out2]) == 0
+    assert "data:image/png;base64," in open(out2).read()
+    # usage errors: no args / missing ledger → 2, no traceback
+    assert mod.main(["edit_report.py"]) == 2
+    assert mod.main(["edit_report.py", str(tmp_path / "nope.jsonl")]) == 2
+
+
+def test_report_tolerates_empty_ledger_and_missing_sidecar(tmp_path):
+    from videop2p_tpu.obs.report import render_report, write_report
+
+    assert "html" in render_report([], {})
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text(json.dumps({"event": "run_start", "run_id": "e"}) + "\n")
+    out = write_report(str(empty))
+    assert os.path.isfile(out)
+
+
+# ------------------------------------------------------ CLI e2e (slow) --
+
+
+@pytest.mark.slow
+def test_cli_fast_edit_report_acceptance(tmp_path):
+    """The ISSUE-4 acceptance run: a tiny-config cached fast edit with
+    --attn_maps --quality --report writes the sidecar + HTML report; the
+    report embeds ≥1 per-word heatmap and the quality table, and the
+    quality RegressionRules evaluate the run's ledger into verdicts."""
+    from videop2p_tpu.cli.run_videop2p import main as p2p
+    from videop2p_tpu.obs import (
+        QUALITY_RULES,
+        evaluate_rules,
+        extract_run,
+        read_ledger,
+        split_runs,
+    )
+
+    ledger_path = str(tmp_path / "ledger.jsonl")
+    inv_gif, edit_gif = p2p(
+        pretrained_model_path=str(tmp_path / "no_ckpt"),
+        image_path="data/rabbit",
+        prompt="a rabbit is jumping",
+        prompts=["a rabbit is jumping", "a origami rabbit is jumping"],
+        save_name="origami", is_word_swap=False,
+        blend_word=["rabbit", "rabbit"],
+        video_len=2, fast=True, tiny=True,
+        attn_maps=True, quality=True, report=True,
+        ledger=ledger_path, reuse_inversion=False,
+    )
+    assert os.path.isfile(inv_gif) and os.path.isfile(edit_gif)
+    folder = os.path.dirname(edit_gif)
+    report = os.path.join(folder, "report_origami_fast.html")
+    assert os.path.isfile(report), sorted(os.listdir(folder))
+    html = open(report).read()
+    # ≥1 per-word heatmap embedded + the quality table
+    assert html.count("data:image/png;base64,") >= 1
+    assert "origami" in html and "rabbit" in html
+    assert "Edit quality" in html and "recon_psnr" in html
+
+    events = read_ledger(ledger_path)
+    attn_evs = [e for e in events if e["event"] == "attn_maps"]
+    scopes = {e["scope"] for e in attn_evs}
+    assert scopes == {"inversion", "edit"}
+    for e in attn_evs:
+        assert os.path.isfile(e["sidecar"])
+        assert e["steps"] == 50
+        assert e["words"] and e["sites"]
+    qual = [e for e in events if e["event"] == "quality"]
+    assert qual and all(k in qual[0] for k in ("recon_psnr", "recon_ssim",
+                                               "background_psnr"))
+    # the sidecar holds the heat stacks, mask series and quality curves
+    sc = np.load(qual[0]["sidecar"])
+    assert "attn_edit/cross_heat" in sc.files
+    assert "attn_edit/mask_heat" in sc.files
+    assert "quality/recon_psnr_frames" in sc.files
+    assert sc["attn_edit/cross_heat"].shape[0] == 50
+
+    # quality RegressionRules evaluate this run's record into verdicts
+    rec = extract_run(split_runs(events)[-1])
+    res = evaluate_rules(rec, rec, QUALITY_RULES)
+    assert res["pass"]
+    assert {v["metric"] for v in res["verdicts"]} >= {"recon_psnr",
+                                                      "background_psnr"}
+
+
+@pytest.mark.slow
+def test_cli_repeat_run_emits_regression_verdicts(tmp_path):
+    """A second quality-enabled run appending to the same ledger gets the
+    cross-run verdict event (the PR-3 engine closing over quality)."""
+    from videop2p_tpu.cli.run_videop2p import main as p2p
+    from videop2p_tpu.obs import read_ledger
+
+    ledger_path = str(tmp_path / "ledger.jsonl")
+    kw = dict(
+        pretrained_model_path=str(tmp_path / "no_ckpt"),
+        image_path="data/rabbit",
+        prompt="a rabbit is jumping",
+        prompts=["a rabbit is jumping", "a origami rabbit is jumping"],
+        save_name="origami", is_word_swap=False,
+        video_len=2, fast=True, tiny=True,
+        quality=True, ledger=ledger_path, reuse_inversion=False,
+    )
+    p2p(**kw)
+    p2p(**kw)
+    events = read_ledger(ledger_path)
+    verdicts = [e for e in events if e["event"] == "regression_verdicts"]
+    assert verdicts, "second run emitted no cross-run verdicts"
+    v = verdicts[-1]
+    assert "verdicts" in v and isinstance(v["verdicts"], list)
+    # identical tiny runs: the quality verdicts exist and pass
+    qv = [x for x in v["verdicts"] if x.get("kind") == "quality"]
+    assert qv and all(not x["regressed"] for x in qv)

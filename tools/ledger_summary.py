@@ -161,6 +161,34 @@ def render(events: List[Dict]) -> str:
     if tel_lines:
         out += ["", "telemetry:"] + tel_lines
 
+    # edit-quality events (obs/quality.py) — the semantic numbers next to
+    # the perf ones; arrays live in the .npz sidecar the event references
+    for e in events:
+        if e.get("event") != "quality":
+            continue
+        vals = ", ".join(
+            f"{k}={v}" for k, v in e.items()
+            if k not in ("event", "t", "program", "sidecar")
+            and isinstance(v, (int, float))
+        )
+        out += ["", f"quality ({e.get('program', '?')}): {vals}"]
+    attn_evs = [e for e in events if e.get("event") == "attn_maps"]
+    if attn_evs:
+        out += ["", "attention capture:"]
+        for e in attn_evs:
+            out.append(
+                f"  {e.get('scope', '?')}: {e.get('steps', '?')} steps, "
+                f"heat {e.get('heat_shape')}, "
+                f"{len(e.get('sites') or [])} sites "
+                f"(sidecar {e.get('sidecar', '-')})"
+            )
+    trace_evs = [e for e in events if e.get("event") == "trace"]
+    if trace_evs:
+        out += ["", "device traces:"] + [
+            f"  {e.get('name', '?')} → {e.get('trace_dir', '?')}"
+            for e in trace_evs
+        ]
+
     metric_events = [e for e in events if e.get("event") == "metric"]
     if metric_events:
         last = metric_events[-1]

@@ -170,6 +170,29 @@ def add_obs_args(parser: argparse.ArgumentParser) -> None:
              "program on each compile) — it re-lowers each program "
              "ahead-of-time, which is persistent-cache-cheap but not free",
     )
+    parser.add_argument(
+        "--attn_maps", action="store_true",
+        help="capture per-step cross-attention observability riding the "
+             "fused DDIM scans (obs/attention.py): pooled per-token "
+             "heatmaps, per-site attention entropies, the LocalBlend mask "
+             "time series — arrays land in an .npz sidecar referenced by "
+             "attn_maps ledger events; capture-off programs stay bit-exact",
+    )
+    parser.add_argument(
+        "--quality", action="store_true",
+        help="compute edit-quality metrics after decode (obs/quality.py): "
+             "inversion-reconstruction PSNR/SSIM vs the input frames, "
+             "background-preservation PSNR outside the blend mask, "
+             "adjacent-frame consistency — emitted as a quality ledger "
+             "event and gated by the quality RegressionRules",
+    )
+    parser.add_argument(
+        "--report", action="store_true",
+        help="render a self-contained HTML edit report (per-word heatmap "
+             "grids, mask overlays, null-text loss sparkline, quality "
+             "table, regression verdicts) next to the run's outputs — "
+             "tools/edit_report.py re-renders it from the ledger+sidecar",
+    )
 
 
 def dependent_suffix(

@@ -400,6 +400,11 @@ if __name__ == "__main__":
     from videop2p_tpu.parallel import initialize_distributed
 
     initialize_distributed()
+    if args.attn_maps or args.quality or args.report:
+        # the flags live in the shared add_obs_args surface; the semantic
+        # layer instruments the EDIT pipelines (run_videop2p)
+        print("[tune] --attn_maps/--quality/--report are Stage-2 (editing) "
+              "knobs — ignored by the tuning CLI")
     cfg = load_config(args.config)
     args.mesh = args.mesh or cfg.pop("mesh", None)
     main(

@@ -54,6 +54,137 @@ GUIDANCE_SCALE = 7.5
 MASK_TH = (0.3, 0.3)
 
 
+def _word_token_records(prompts: Sequence[str], tokenizer) -> list:
+    """Word → token-position records for every prompt (the report's key
+    for slicing per-word heatmaps out of the per-token capture)."""
+    from videop2p_tpu.control.schedules import get_word_inds
+
+    recs, seen = [], set()
+    for pi, text in enumerate(prompts):
+        for word in text.split():
+            if (pi, word) in seen:
+                continue
+            seen.add((pi, word))
+            toks = get_word_inds(text, word, tokenizer)
+            if len(toks):
+                recs.append({"prompt": pi, "word": word,
+                             "tokens": [int(t) for t in toks]})
+    return recs
+
+
+def _semantic_obs(
+    run_ledger,
+    *,
+    output_folder: str,
+    save_name: str,
+    suffix: str,
+    prompts: Sequence[str],
+    tokenizer,
+    attn_records: Dict,
+    stream_map: Dict,
+    quality: bool,
+    report: bool,
+    source01: np.ndarray,
+    videos: np.ndarray,
+) -> Optional[str]:
+    """Post-decode semantic observability: the ``.npz`` sidecar, the
+    ``attn_maps``/``quality`` ledger events, cross-run regression verdicts
+    (quality rules included), and the self-contained HTML report. Returns
+    the report path when one was written."""
+    from videop2p_tpu.obs.attention import save_obs_sidecar, summarize_attn_record
+
+    sidecar_path = os.path.join(
+        output_folder, f"obs_sidecar_{save_name}{suffix}.npz"
+    )
+    sidecar: Dict[str, np.ndarray] = {}
+    word_recs = _word_token_records(prompts, tokenizer)
+    summaries = {}
+    for scope, rec in attn_records.items():
+        sidecar[f"attn_{scope}/cross_heat"] = np.asarray(rec["cross_heat"])
+        for site, curve in sorted(rec.get("entropy", {}).items()):
+            sidecar[f"attn_{scope}/entropy/{site}"] = np.asarray(curve)
+        for k in ("mask_cov", "mask_heat", "blend_active"):
+            if k in rec:
+                sidecar[f"attn_{scope}/{k}"] = np.asarray(rec[k])
+        summaries[scope] = summarize_attn_record(rec)
+
+    # reference frames for the report's overlays, bounded at 128px
+    stride = max(1, int(videos.shape[-3]) // 128)
+    to_u8 = lambda v: (np.clip(v[:, ::stride, ::stride], 0, 1) * 255).astype(np.uint8)  # noqa: E731
+    sidecar["frames/source"] = to_u8(np.asarray(source01))
+    sidecar["frames/recon"] = to_u8(videos[0])
+    sidecar["frames/edit"] = to_u8(videos[1])
+
+    quality_summary = None
+    if quality:
+        from videop2p_tpu.obs.quality import edit_quality_record
+
+        mask = None
+        mh = attn_records.get("edit", {}).get("mask_heat")
+        if mh is not None:
+            mh = np.asarray(mh)  # (T, P, F, rh, rw), source stream first
+            if mh.ndim == 5 and mh.shape[1] >= 2:
+                m = np.clip(mh[-1, 1], 0.0, 1.0)  # final step, first edit
+                F, H, W = videos.shape[1], videos.shape[2], videos.shape[3]
+                yi = (np.arange(H) * m.shape[1] // max(H, 1)).clip(0, m.shape[1] - 1)
+                xi = (np.arange(W) * m.shape[2] // max(W, 1)).clip(0, m.shape[2] - 1)
+                mask = m[:F][:, yi][:, :, xi]
+        quality_summary, curves = edit_quality_record(
+            np.asarray(source01), videos[0], videos[1], mask=mask
+        )
+        for k, v in curves.items():
+            sidecar[f"quality/{k}"] = v
+
+    save_obs_sidecar(sidecar_path, sidecar)
+
+    for scope, summary in summaries.items():
+        streams = stream_map.get(scope, [])
+        run_ledger.event(
+            "attn_maps", scope=scope, program=f"attn_{scope}",
+            sidecar=sidecar_path, streams=streams,
+            words=[w for w in word_recs if w["prompt"] in streams],
+            **summary,
+        )
+    if quality_summary is not None:
+        run_ledger.event("quality", program="edit_quality",
+                         sidecar=sidecar_path, **quality_summary)
+        print("[p2p] quality: " + ", ".join(
+            f"{k}={v}" for k, v in quality_summary.items()))
+
+    # cross-run regression verdicts (PR-3 engine + the quality rules):
+    # the ledger file appends across invocations, so a repeat run has its
+    # baseline in the same file — best-effort, never takes the run down
+    try:
+        from videop2p_tpu.obs import history as _history
+        from videop2p_tpu.obs.ledger import read_ledger
+
+        recs = [_history.extract_run(r)
+                for r in _history.split_runs(read_ledger(run_ledger.path))]
+        if len(recs) >= 2:
+            cur = recs[-1]
+            base = _history.RunHistory(recs[:-1]).baseline_for(cur) or recs[-2]
+            res = _history.evaluate_rules(base, cur)
+            run_ledger.event("regression_verdicts",
+                             baseline_run_id=base.get("run_id"), **res)
+            if not res["pass"]:
+                print(f"[p2p] REGRESSIONS vs run {base.get('run_id')}: "
+                      + ", ".join(v["rule"] for v in res["regressions"]))
+    except Exception as e:  # noqa: BLE001 — observability never kills a run
+        print(f"[p2p] regression verdicts skipped: {e}")
+
+    report_path = None
+    if report:
+        from videop2p_tpu.obs.report import write_report
+
+        report_path = write_report(
+            run_ledger.path,
+            os.path.join(output_folder, f"report_{save_name}{suffix}.html"),
+            sidecar_path,
+        )
+        print(f"[p2p] edit report: {report_path}")
+    return report_path
+
+
 def main(
     pretrained_model_path: str,
     image_path: str,
@@ -110,6 +241,14 @@ def main(
     # fused scans + a JSONL run ledger (phases, compile events, memory)
     telemetry: bool = False,
     ledger: Optional[str] = None,
+    # semantic observability (ISSUE 4): per-step cross-attention capture
+    # riding the same fused scans (obs/attention.py), post-decode edit-
+    # quality metrics (obs/quality.py), and the self-contained HTML run
+    # report (obs/report.py / tools/edit_report.py). Any of them implies
+    # a run ledger (default path) — the events are the report's input.
+    attn_maps: bool = False,
+    quality: bool = False,
+    report: bool = False,
     # automatic XLA cost/memory analysis of each instrumented program on
     # compile (program_analysis ledger events; obs/introspect.py) — the
     # per-program peak-HBM estimate the memory snapshots are checked
@@ -146,14 +285,16 @@ def main(
     # telemetry summary and memory snapshot below lands in ONE JSONL stream
     # (events are line-flushed, so a killed run keeps what it measured)
     run_ledger = None
-    if telemetry or ledger:
+    if telemetry or ledger or attn_maps or quality or report:
         from videop2p_tpu import obs
 
         run_ledger = obs.RunLedger(
             ledger or os.path.join(output_folder, "run_ledger.jsonl"),
             mesh=mesh,
             meta={"cli": "run_videop2p", "fast": fast, "save_name": save_name,
-                  "prompt": prompt, "telemetry": bool(telemetry),
+                  "prompt": prompt, "prompts": list(prompts),
+                  "telemetry": bool(telemetry),
+                  "attn_maps": bool(attn_maps), "quality": bool(quality),
                   "null_text_precision": null_text_precision},
         ).activate()
 
@@ -353,6 +494,8 @@ def main(
     null_embeddings = None
     out = None
     videos = None
+    # {"inversion": rec, "edit": rec} when --attn_maps captured anything
+    attn_records = {}
     if use_cached:
         # capture + controlled denoise as ONE device program (the shared
         # pipelines.cached_fast_edit — the same program bench.py measures):
@@ -378,25 +521,33 @@ def main(
                     key=k,
                     temporal_maps_dtype=tm_dtype,
                     telemetry=telemetry,
+                    attn_maps=attn_maps,
                 )
                 traj, edited = res[0], res[1]
                 vids = decode_video(bundle.vae, vp, edited.astype(dtype), sequential=True)
-                out = (traj, (vids.astype(jnp.float32) + 1) / 2)
-                return out + (res[2],) if telemetry else out
+                return (traj, (vids.astype(jnp.float32) + 1) / 2) + tuple(res[2:])
 
             res = instrumented_jit(fused_to_video, program="cached_invert_edit")(
                 params, bundle.vae_params, latents, ik
             )
             traj, videos = res[0], res[1]
+            extras = list(res[2:])
             videos = np.asarray(jax.device_get(videos))
-            if telemetry and run_ledger is not None:
-                from videop2p_tpu.obs import decode_step_stats, summarize_step_stats
+            if telemetry:
+                tel = extras.pop(0)
+                if run_ledger is not None:
+                    from videop2p_tpu.obs import (
+                        decode_step_stats,
+                        summarize_step_stats,
+                    )
 
-                run_ledger.telemetry(
-                    "cached_invert_edit",
-                    {"summary": summarize_step_stats(res[2]),
-                     "steps": decode_step_stats(res[2])},
-                )
+                    run_ledger.telemetry(
+                        "cached_invert_edit",
+                        {"summary": summarize_step_stats(tel),
+                         "steps": decode_step_stats(tel)},
+                    )
+            if attn_maps:
+                attn_records = jax.device_get(extras.pop(0))
         if run_ledger is not None:
             # measured peak next to the program_analysis predicted peak-HBM
             # (the instrumented_jit cache miss above recorded it) — the
@@ -422,16 +573,22 @@ def main(
             null_embeddings = jnp.asarray(null_np)
     else:
         with phase_timer("ddim_inversion"):
-            traj = instrumented_jit(
+            inv = instrumented_jit(
                 lambda p, x, k: ddim_inversion(
                     unet_fn, p, sched, x, cond_src,
                     num_inference_steps=NUM_DDIM_STEPS,
                     dependent_weight=dep_w,
                     dependent_sampler=sampler if dep_w > 0 else None,
                     key=k,
+                    attn_maps=attn_maps,
                 ),
                 program="ddim_inversion",
             )(params, latents, ik)
+            if attn_maps:
+                traj, inv_attn = inv
+                attn_records["inversion"] = jax.device_get(inv_attn)
+            else:
+                traj = inv
             x_t = jax.block_until_ready(traj[-1])
         if reuse_inversion:
             save_inversion(
@@ -543,11 +700,16 @@ def main(
                     dependent_sampler=sampler if (dependent_p2p and eta > 0) else None,
                     null_uncond_embeddings=null_embeddings,
                     telemetry=telemetry,
+                    attn_maps=attn_maps,
                 ),
                 program="edit_sample",
             )(params, x_t, uncond, ek)
-            if telemetry:
-                out, edit_tel = out
+            if telemetry or attn_maps:
+                out, *edit_extras = out
+                if telemetry:
+                    edit_tel = edit_extras.pop(0)
+                if attn_maps:
+                    attn_records["edit"] = jax.device_get(edit_extras.pop(0))
             out = jax.block_until_ready(out)
         print(f"[p2p] controlled denoise done in {time.perf_counter() - t0:.1f}s")
         if telemetry and run_ledger is not None:
@@ -582,9 +744,32 @@ def main(
     save_video_gif(videos[0], inversion_gif, fps=4)
     save_video_gif(videos[1], edit_gif, fps=4)
     print(f"[p2p] wrote {inversion_gif} and {edit_gif}")
+
+    # semantic observability (ISSUE 4): attention sidecar + quality
+    # metrics + regression verdicts + the self-contained HTML report
+    report_path = None
+    if run_ledger is not None and (attn_records or quality or report):
+        report_path = _semantic_obs(
+            run_ledger,
+            output_folder=output_folder, save_name=save_name, suffix=suffix,
+            prompts=list(prompts), tokenizer=bundle.tokenizer,
+            attn_records=attn_records,
+            # which prompt stream each capture's heat axis holds: the
+            # inversion walk sees only the source; the cached edit batch
+            # drops the source stream, the live edit keeps all P
+            stream_map={
+                "inversion": [0],
+                "edit": (list(range(1, len(prompts))) if use_cached
+                         else list(range(len(prompts)))),
+            },
+            quality=quality, report=report,
+            source01=np.asarray(jax.device_get((video[0] + 1.0) / 2.0)),
+            videos=videos,
+        )
+
     if run_ledger is not None:
         run_ledger.event("artifacts", inversion_gif=inversion_gif,
-                         edit_gif=edit_gif)
+                         edit_gif=edit_gif, report=report_path)
         run_ledger.memory_snapshot(note="run_end")
         run_ledger.close()
         print(f"[p2p] run ledger: {run_ledger.path}")
@@ -652,4 +837,7 @@ if __name__ == "__main__":
         telemetry=args.telemetry,
         ledger=args.ledger,
         program_analysis=not args.no_program_analysis,
+        attn_maps=args.attn_maps,
+        quality=args.quality,
+        report=args.report,
     )

@@ -15,7 +15,12 @@ from videop2p_tpu.control.controllers import (
     make_spatial_replace_controller,
     control_attention,
 )
-from videop2p_tpu.control.local_blend import LocalBlendConfig, make_local_blend, local_blend
+from videop2p_tpu.control.local_blend import (
+    LocalBlendConfig,
+    blend_mask,
+    local_blend,
+    make_local_blend,
+)
 
 __all__ = [
     "get_refinement_mapper",
@@ -30,4 +35,5 @@ __all__ = [
     "LocalBlendConfig",
     "make_local_blend",
     "local_blend",
+    "blend_mask",
 ]

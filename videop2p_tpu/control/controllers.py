@@ -82,12 +82,34 @@ def get_equalizer(
     tokenizer: Tokenizer,
     max_len: int = MAX_NUM_WORDS,
 ) -> np.ndarray:
-    """Per-token attention rescale factors (run_videop2p.py:372-381)."""
+    """Per-token attention rescale factors (run_videop2p.py:372-381).
+
+    The reference silently no-ops on two misconfigurations: a word that
+    does not tokenize to any position of ``text`` writes nothing
+    (``eq[:, []] = val``), and a ``words``/``values`` length mismatch is
+    truncated by ``zip``. Both mean the requested reweight never happens —
+    raise instead, with the offending word/lengths in the message.
+    """
     eq = np.ones((1, max_len), dtype=np.float32)
     if isinstance(words, str):
         words = (words,)
+    if isinstance(values, (int, float)):
+        values = (values,)
+    words = list(words)
+    values = list(values)
+    if len(words) != len(values):
+        raise ValueError(
+            f"equalizer words/values length mismatch: {len(words)} word(s) "
+            f"{words!r} vs {len(values)} value(s) {values!r}"
+        )
     for word, val in zip(words, values):
         inds = get_word_inds(text, word, tokenizer)
+        if len(inds) == 0:
+            raise ValueError(
+                f"equalizer word {word!r} does not tokenize to any position "
+                f"of the edit prompt {text!r} — the reweight would silently "
+                "never apply"
+            )
         eq[:, inds] = float(val)
     return eq
 

@@ -26,7 +26,7 @@ from flax import struct
 from videop2p_tpu.control.schedules import get_word_inds
 from videop2p_tpu.utils.tokenizers import MAX_NUM_WORDS, Tokenizer
 
-__all__ = ["LocalBlendConfig", "make_local_blend", "local_blend"]
+__all__ = ["LocalBlendConfig", "make_local_blend", "local_blend", "blend_mask"]
 
 
 class LocalBlendConfig(struct.PyTreeNode):
@@ -110,6 +110,21 @@ def _get_mask(
     return mask
 
 
+def blend_mask(
+    maps: jax.Array, cfg: LocalBlendConfig, out_hw: Tuple[int, int]
+) -> jax.Array:
+    """The boolean word mask LocalBlend applies, as its own seam —
+    (P, F, h, w) from the (P, F, S, r, r, 77) running-sum maps. Factored
+    out of :func:`local_blend` (identical math, so the blend program is
+    unchanged) so the attention-observability capture can record the mask
+    time series / coverage fraction the blend actually used."""
+    mask = _get_mask(maps, cfg.alpha_layers[:, 0, :], True, out_hw, cfg.th)
+    if cfg.substruct_layers is not None:
+        sub = _get_mask(maps, cfg.substruct_layers[:, 0, :], False, out_hw, cfg.th)
+        mask = jnp.logical_and(mask, jnp.logical_not(sub))
+    return mask
+
+
 def local_blend(
     x_t: jax.Array,
     maps: jax.Array,
@@ -124,11 +139,7 @@ def local_blend(
     Active once ``step_index >= start_blend`` (the reference's counter>start
     gate, run_videop2p.py:143-144).
     """
-    out_hw = x_t.shape[2:4]
-    mask = _get_mask(maps, cfg.alpha_layers[:, 0, :], True, out_hw, cfg.th)
-    if cfg.substruct_layers is not None:
-        sub = _get_mask(maps, cfg.substruct_layers[:, 0, :], False, out_hw, cfg.th)
-        mask = jnp.logical_and(mask, jnp.logical_not(sub))
+    mask = blend_mask(maps, cfg, x_t.shape[2:4])
     maskf = mask.astype(x_t.dtype)[..., None]  # (P,F,h,w,1)
     blended = x_t[:1] + maskf * (x_t - x_t[:1])
     active = step_index >= cfg.start_blend

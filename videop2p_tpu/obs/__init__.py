@@ -1,7 +1,19 @@
-"""Observability: in-program telemetry, the unified run ledger, and
-compiled-program introspection with a cross-run regression engine.
+"""Observability: in-program telemetry, the unified run ledger,
+compiled-program introspection with a cross-run regression engine, and
+the semantic layer — attention capture, edit-quality metrics and the
+self-contained HTML run report.
 
-Four pillars (ISSUEs 2 and 3):
+Pillars (ISSUEs 2–4):
+
+  * :mod:`videop2p_tpu.obs.attention` — fixed-shape per-step cross-
+    attention capture (pooled per-token heatmaps, per-site entropies, the
+    LocalBlend mask series) riding the fused DDIM scans; host decoders +
+    the ``.npz`` sidecar writer.
+  * :mod:`videop2p_tpu.obs.quality` — pure-JAX PSNR/SSIM, inversion-
+    reconstruction / background-preservation / adjacent-frame-consistency
+    metrics, folded into the ledger ``quality`` event.
+  * :mod:`videop2p_tpu.obs.report` — one self-contained HTML report per
+    run (stdlib+numpy; ``tools/edit_report.py`` is the CLI).
 
   * :mod:`videop2p_tpu.obs.telemetry` — fixed-shape telemetry buffers that
     ride the fused pipelines' existing ``lax.scan`` outputs (zero extra
@@ -27,8 +39,18 @@ Everything here is OFF by default: with no active ledger and
 un-instrumented forms (tests/test_obs.py pins this).
 """
 
+from videop2p_tpu.obs.attention import (
+    ATTN_HEAT_RES,
+    attn_step_record,
+    cross_attention_heat,
+    load_obs_sidecar,
+    save_obs_sidecar,
+    site_entropies,
+    summarize_attn_record,
+)
 from videop2p_tpu.obs.history import (
     DEFAULT_RULES,
+    QUALITY_RULES,
     RegressionRule,
     RunHistory,
     evaluate_rules,
@@ -48,6 +70,14 @@ from videop2p_tpu.obs.ledger import (
     instrumented_jit,
     program_label,
     read_ledger,
+)
+from videop2p_tpu.obs.quality import (
+    adjacent_frame_psnr,
+    edit_quality_record,
+    frame_psnr,
+    masked_psnr,
+    psnr,
+    ssim,
 )
 from videop2p_tpu.obs.telemetry import (
     decode_null_text_stats,
@@ -81,4 +111,18 @@ __all__ = [
     "summarize_step_stats",
     "sparkline",
     "telemetry_overhead_record",
+    "ATTN_HEAT_RES",
+    "attn_step_record",
+    "cross_attention_heat",
+    "site_entropies",
+    "summarize_attn_record",
+    "save_obs_sidecar",
+    "load_obs_sidecar",
+    "QUALITY_RULES",
+    "psnr",
+    "ssim",
+    "masked_psnr",
+    "frame_psnr",
+    "adjacent_frame_psnr",
+    "edit_quality_record",
 ]

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import dataclasses
 import glob
+import math
 import os
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
@@ -36,6 +37,7 @@ from videop2p_tpu.obs.ledger import read_ledger
 __all__ = [
     "RegressionRule",
     "DEFAULT_RULES",
+    "QUALITY_RULES",
     "split_runs",
     "extract_run",
     "evaluate_rules",
@@ -52,10 +54,15 @@ class RegressionRule:
     ``kind`` selects the record section the metric lives in: ``"program"``
     (program_analysis metrics), ``"compile"`` (per-program compile
     seconds), ``"phase"`` (phase wall-clock), ``"dispatch"`` (program_call
-    dispatch seconds). ``min_abs`` suppresses verdicts whose absolute delta
-    is noise-sized (a 0.001 s phase doubling is not a regression).
-    ``programs`` (labels for program/compile/dispatch kinds, phase names
-    for phases) restricts the rule; None applies it everywhere.
+    dispatch seconds), ``"quality"`` (edit-quality metrics from the
+    ``quality`` ledger event — PSNR/SSIM). ``min_abs`` suppresses verdicts
+    whose absolute delta is noise-sized (a 0.001 s phase doubling is not a
+    regression). ``programs`` (labels for program/compile/dispatch kinds,
+    phase names for phases) restricts the rule; None applies it everywhere.
+
+    ``direction``: ``"increase"`` (the default — flops/bytes/seconds
+    regress by GROWING) or ``"decrease"`` for metrics that regress by
+    DROPPING (reconstruction / background-preservation PSNR, SSIM).
     """
 
     metric: str
@@ -63,11 +70,27 @@ class RegressionRule:
     threshold_pct: float = 10.0
     min_abs: float = 0.0
     programs: Optional[Tuple[str, ...]] = None
+    direction: str = "increase"
 
     @property
     def name(self) -> str:
-        return f"{self.kind}:{self.metric}+{self.threshold_pct:g}%"
+        sign = "-" if self.direction == "decrease" else "+"
+        return f"{self.kind}:{self.metric}{sign}{self.threshold_pct:g}%"
 
+
+# edit-quality gates (ISSUE 4): a reconstruction or background-
+# preservation drop regresses a run exactly like a perf metric growing.
+# PSNR thresholds are percentage-of-dB with an absolute 0.5 dB noise
+# floor; inf→inf (bit-exact reconstruction both runs) is a clean pass and
+# inf→finite (the exactness guarantee was LOST) always regresses.
+QUALITY_RULES: Tuple[RegressionRule, ...] = (
+    RegressionRule("recon_psnr", kind="quality", direction="decrease",
+                   threshold_pct=5.0, min_abs=0.5),
+    RegressionRule("background_psnr", kind="quality", direction="decrease",
+                   threshold_pct=5.0, min_abs=0.5),
+    RegressionRule("recon_ssim", kind="quality", direction="decrease",
+                   threshold_pct=2.0, min_abs=0.005),
+)
 
 DEFAULT_RULES: Tuple[RegressionRule, ...] = (
     RegressionRule("flops", threshold_pct=10.0),
@@ -77,7 +100,7 @@ DEFAULT_RULES: Tuple[RegressionRule, ...] = (
     RegressionRule("hlo_instructions", threshold_pct=25.0, min_abs=16),
     RegressionRule("seconds", kind="compile", threshold_pct=50.0, min_abs=1.0),
     RegressionRule("seconds", kind="phase", threshold_pct=25.0, min_abs=0.5),
-)
+) + QUALITY_RULES
 
 
 def split_runs(events: Iterable[Dict[str, Any]]) -> List[List[Dict[str, Any]]]:
@@ -116,6 +139,7 @@ def extract_run(events: Sequence[Dict[str, Any]],
         "compiles": {},
         "phases": {},
         "dispatch": {},
+        "quality": {},
     }
     for e in events:
         kind = e.get("event")
@@ -149,6 +173,14 @@ def extract_run(events: Sequence[Dict[str, Any]],
                 )
             except (TypeError, ValueError):
                 continue
+        elif kind == "quality":
+            # numeric metric fields only; a later quality event supersedes
+            # (re-measured after a fix within the same run)
+            for k, v in e.items():
+                if k in ("event", "t", "program", "sidecar"):
+                    continue
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    rec["quality"][k] = float(v)
     return rec
 
 
@@ -167,6 +199,10 @@ def _rule_values(record: Dict[str, Any], rule: RegressionRule) -> Dict[str, floa
             out[name] = float(p.get("seconds", 0.0))
     elif rule.kind == "dispatch":
         out = {k: float(v) for k, v in record.get("dispatch", {}).items()}
+    elif rule.kind == "quality":
+        q = record.get("quality", {})
+        if rule.metric in q:
+            out["edit_quality"] = float(q[rule.metric])
     if rule.programs is not None:
         out = {k: v for k, v in out.items() if k in rule.programs}
     return out
@@ -196,8 +232,25 @@ def evaluate_rules(
         for label in sorted(set(bvals) & set(nvals)):
             b, n = bvals[label], nvals[label]
             delta = n - b
-            delta_pct = (n / b - 1.0) * 100.0 if b else (0.0 if not n else float("inf"))
-            regressed = delta_pct > rule.threshold_pct and abs(delta) >= rule.min_abs
+            if rule.direction == "decrease":
+                # quality metrics regress by DROPPING; inf baselines (an
+                # exact reconstruction) pass only against inf, and losing
+                # the exactness pedestal is always a regression
+                if math.isinf(b) and math.isinf(n):
+                    delta_pct = 0.0
+                elif math.isinf(b):
+                    delta_pct = 100.0
+                elif math.isinf(n):
+                    delta_pct = 0.0 if n > 0 else float("inf")
+                else:
+                    delta_pct = (b - n) / abs(b) * 100.0 if b else (
+                        0.0 if n >= b else float("inf"))
+                big_enough = abs(delta) >= rule.min_abs or math.isinf(delta)
+            else:
+                delta_pct = (n / b - 1.0) * 100.0 if b else (
+                    0.0 if not n else float("inf"))
+                big_enough = abs(delta) >= rule.min_abs
+            regressed = delta_pct > rule.threshold_pct and big_enough
             v: Dict[str, Any] = {
                 "rule": rule.name,
                 "kind": rule.kind,

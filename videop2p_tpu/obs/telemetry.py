@@ -56,9 +56,12 @@ def latent_stats(x) -> Dict[str, jnp.ndarray]:
 
 
 def decode_step_stats(stats: Dict) -> List[Dict[str, float]]:
-    """Stacked ``(num_steps,)`` telemetry arrays → one record per step."""
+    """Stacked ``(num_steps,)`` telemetry arrays → one record per step.
+    Degenerate inputs (no fields, zero-length curves) decode to ``[]``
+    rather than raising — a killed run's partial stats must still land in
+    the ledger."""
     host = {k: np.asarray(v) for k, v in stats.items()}
-    n = len(next(iter(host.values())))
+    n = min((len(v) for v in host.values()), default=0)
     out = []
     for i in range(n):
         rec = {"step": i}
@@ -71,9 +74,15 @@ def decode_step_stats(stats: Dict) -> List[Dict[str, float]]:
 
 def summarize_step_stats(stats: Dict) -> Dict[str, float]:
     """Ledger-sized summary of a per-step stats tree: curve extremes plus
-    total NaN/inf counts (the "did anything blow up, and when" record)."""
+    total NaN/inf counts (the "did anything blow up, and when" record).
+    Degenerate inputs (no fields, zero-length curves) summarize to
+    ``{"steps": 0}``; NaN/inf VALUES in the curves pass through — the
+    counts are the detectors, the extremes report what was measured."""
     host = {k: np.asarray(v, np.float64) for k, v in stats.items()}
-    summary: Dict[str, float] = {"steps": int(len(next(iter(host.values()))))}
+    n = min((len(v) for v in host.values()), default=0)
+    summary: Dict[str, float] = {"steps": int(n)}
+    if n == 0:
+        return summary
     if "abs_max" in host:
         summary["abs_max_peak"] = round(float(host["abs_max"].max()), 6)
         summary["abs_max_final"] = round(float(host["abs_max"][-1]), 6)

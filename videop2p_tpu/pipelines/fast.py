@@ -132,15 +132,20 @@ def cached_fast_edit(
     key: Optional[jax.Array] = None,
     temporal_maps_dtype=None,
     telemetry: bool = False,
+    attn_maps: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """Capture-inversion of ``latents`` under ``cond_src`` followed by the
     cached-source controlled edit under ``cond_all``/``uncond``. Returns
     ``(trajectory, edited_latents)`` — the trajectory for persistence, the
     (P, F, h, w, C) output with stream 0 the exact reconstruction.
-    ``telemetry=True`` returns ``(trajectory, edited, tel)`` with the edit
-    scan's per-step telemetry (sampling.edit_sample) riding the same fused
-    program; off by default, leaving the program byte-identical."""
-    trajectory, cached = ddim_inversion_captured(
+    ``telemetry=True`` adds the edit scan's per-step telemetry
+    (sampling.edit_sample) riding the same fused program; ``attn_maps=True``
+    adds the attention observability capture (obs.attention) as
+    ``{"inversion": ..., "edit": ...}`` — the source stream's heatmaps from
+    the inversion walk plus the edit streams' heatmaps / entropies / blend
+    mask series. Return order ``(trajectory, edited[, tel][, attn])``; both
+    off by default, leaving the program byte-identical."""
+    inv = ddim_inversion_captured(
         unet_fn, params, scheduler, latents, cond_src,
         num_inference_steps=num_inference_steps,
         cross_len=cross_len,
@@ -150,7 +155,9 @@ def cached_fast_edit(
         dependent_sampler=dependent_sampler,
         key=key,
         temporal_maps_dtype=temporal_maps_dtype,
+        attn_maps=attn_maps,
     )
+    trajectory, cached = inv[0], inv[1]
     edited = edit_sample(
         unet_fn, params, scheduler, trajectory[-1], cond_all, uncond,
         num_inference_steps=num_inference_steps,
@@ -159,8 +166,14 @@ def cached_fast_edit(
         source_uses_cfg=False,
         cached_source=cached,
         telemetry=telemetry,
+        attn_maps=attn_maps,
     )
+    if not (telemetry or attn_maps):
+        return trajectory, edited
+    edited, *extras = edited
+    out = (trajectory, edited)
     if telemetry:
-        edited, tel = edited
-        return trajectory, edited, tel
-    return trajectory, edited
+        out += (extras.pop(0),)
+    if attn_maps:
+        out += ({"inversion": inv[2], "edit": extras.pop(0)},)
+    return out

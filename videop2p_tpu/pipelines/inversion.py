@@ -41,6 +41,7 @@ import optax
 from videop2p_tpu.core.ddim import DDIMScheduler
 from videop2p_tpu.core.noise import DependentNoiseSampler
 from videop2p_tpu.models.attention import AttnControl
+from videop2p_tpu.obs.attention import attn_step_record
 from videop2p_tpu.obs.telemetry import latent_stats
 from videop2p_tpu.pipelines.cached import CachedSource, filter_site_tree
 from videop2p_tpu.pipelines.sampling import UNetFn
@@ -83,6 +84,7 @@ def ddim_inversion(
     dependent_sampler: Optional[DependentNoiseSampler] = None,
     key: Optional[jax.Array] = None,
     return_eps: bool = False,
+    attn_maps: bool = False,
 ):
     """Invert clean latents x_0 to noise x_T.
 
@@ -104,6 +106,12 @@ def ddim_inversion(
     drifting latent (pipeline_tuneavideo.py:412-415) and only approximately
     reconstructs. This is the seam for replaying the source stream without
     re-running its forwards (tests/test_pipelines.py pins the property).
+
+    ``attn_maps``: also stack the per-step attention observability record
+    (obs.attention — pooled per-token cross heatmaps of the source stream
+    + per-site entropies, riding the scan's ``ys``) and append it to the
+    return. Step axis follows the inversion walk (x_0 → x_T). Return
+    order: ``trajectory[, eps_seq][, attn]``.
     """
     # latents stay float32 through the walk regardless of the UNet's compute
     # dtype — scheduler math is fp32 (the reference keeps the Stage-2 UNet and
@@ -115,9 +123,13 @@ def ddim_inversion(
     if key is None:
         key = jax.random.key(0)
 
+    video_length = latents.shape[1]
+    latent_hw = latents.shape[2:4]
+    text_len = cond_embedding.shape[-2]
+
     def body(carry, t):
         latent, key = carry
-        eps, _ = unet_fn(params, latent, t, cond_embedding, None)
+        eps, store = unet_fn(params, latent, t, cond_embedding, None)
         if dependent_weight > 0.0:
             if dependent_sampler is None:
                 raise ValueError("dependent_weight > 0 requires dependent_sampler")
@@ -125,17 +137,27 @@ def ddim_inversion(
             ar_noise = dependent_sampler.sample_like(sub, eps)
             eps = (1.0 - dependent_weight) * eps + dependent_weight * ar_noise
         latent = scheduler.next_step(eps, t, latent, num_inference_steps)
-        # return_eps is static: without it the scan must not stack a dead
-        # trajectory-sized ε buffer (eager callers get no DCE)
-        ys = (latent, eps.astype(jnp.float32)) if return_eps else latent
+        # return_eps/attn_maps are static: without them the scan must not
+        # stack dead buffers (eager callers get no DCE)
+        ys = {"latent": latent}
+        if return_eps:
+            ys["eps"] = eps.astype(jnp.float32)
+        if attn_maps:
+            ys["attn"] = attn_step_record(
+                store, num_uncond=0, num_cond=latent.shape[0],
+                video_length=video_length, text_len=text_len,
+                latent_hw=latent_hw,
+            )
         return (latent, key), ys
 
     (_, _), ys = jax.lax.scan(body, (latents, key), timesteps)
-    trajectory, eps_seq = ys if return_eps else (ys, None)
-    full = jnp.concatenate([latents[None], trajectory], axis=0)
+    full = jnp.concatenate([latents[None], ys["latent"]], axis=0)
+    out = (full,)
     if return_eps:
-        return full, eps_seq
-    return full
+        out += (ys["eps"],)
+    if attn_maps:
+        out += (ys["attn"],)
+    return out if len(out) > 1 else full
 
 
 def ddim_inversion_captured(
@@ -154,9 +176,17 @@ def ddim_inversion_captured(
     dependent_sampler: Optional[DependentNoiseSampler] = None,
     key: Optional[jax.Array] = None,
     temporal_maps_dtype=None,
+    attn_maps: bool = False,
 ) -> Tuple[jax.Array, CachedSource]:
     """DDIM inversion that also captures everything a cached-source edit
     needs (see :mod:`videop2p_tpu.pipelines.cached` for the design).
+
+    ``attn_maps``: additionally stack the per-step attention
+    observability record of the SOURCE stream (obs.attention — pooled
+    per-token cross heatmaps + per-site entropies; in cached fast mode
+    this is the only place source-stream maps are visible, the edit batch
+    having dropped the stream) and return it as a third element,
+    step-axis in inversion-walk order.
 
     ``temporal_maps_dtype``: optional narrower STORAGE dtype for the
     captured temporal (attn_temp) probability maps — e.g.
@@ -223,6 +253,12 @@ def ddim_inversion_captured(
                 eps = (1.0 - dependent_weight) * eps + dependent_weight * ar_noise
             latent = scheduler.next_step(eps, t, latent, N)
             ys = {"latent": latent}
+            if attn_maps:
+                ys["attn"] = attn_step_record(
+                    store, num_uncond=0, num_cond=latent.shape[0],
+                    video_length=video_length, text_len=text_len,
+                    latent_hw=latent_hw,
+                )
             if capture_blend:
                 ys["blend"] = blend_maps_from_store(
                     store,
@@ -251,11 +287,14 @@ def ddim_inversion_captured(
     bounds = sorted({0, N - hi, N - lo, N - cross_len, N})
     carry = (latents, key)
     lat_pieces, blend_pieces, cross_pieces, temporal_pieces = [], [], [], []
+    attn_pieces = []
     for s, e in zip(bounds[:-1], bounds[1:]):
         want_cross = s >= N - cross_len
         want_temporal = s >= N - hi and e <= N - lo
         carry, ys = run_segment(*carry, timesteps[s:e], want_cross, want_temporal)
         lat_pieces.append(ys["latent"])
+        if attn_maps:
+            attn_pieces.append(ys["attn"])
         if capture_blend:
             blend_pieces.append(ys["blend"])
         if want_cross:
@@ -279,6 +318,11 @@ def ddim_inversion_captured(
         cross_len=cross_len,
         self_window=(lo, hi),
     )
+    if attn_maps:
+        attn = jax.tree.map(
+            lambda *xs: jnp.concatenate(xs, axis=0), *attn_pieces
+        )
+        return trajectory, cached, attn
     return trajectory, cached
 
 

@@ -30,10 +30,11 @@ import jax
 import jax.numpy as jnp
 
 from videop2p_tpu.control.controllers import ControlContext
-from videop2p_tpu.control.local_blend import local_blend
+from videop2p_tpu.control.local_blend import blend_mask, local_blend
 from videop2p_tpu.core.ddim import DDIMScheduler
 from videop2p_tpu.core.noise import DependentNoiseSampler
 from videop2p_tpu.models.attention import AttnControl
+from videop2p_tpu.obs.attention import ATTN_HEAT_RES, attn_step_record
 from videop2p_tpu.obs.telemetry import latent_stats
 from videop2p_tpu.pipelines.cached import CachedSource
 from videop2p_tpu.pipelines.stores import blend_maps_from_store
@@ -62,6 +63,32 @@ def _controller_gates(ctx: Optional[ControlContext], i) -> dict:
         "cross_gate_mean": jnp.mean(ctx.cross_replace_alpha[i]).astype(jnp.float32),
         "self_edit_active": jnp.logical_and(i >= lo, i < hi).astype(jnp.int32),
     }
+
+
+def _mask_series_entry(maps_sum, blend_cfg, step_index, latent_hw):
+    """The LocalBlend observability channels for one step: the mask the
+    blend used (obs.attention's pooled resolution), its per-stream/frame
+    coverage fraction, and whether the blend gate was open."""
+    mask = blend_mask(maps_sum, blend_cfg, latent_hw).astype(jnp.float32)
+    pooled = jax.image.resize(
+        mask, mask.shape[:2] + ATTN_HEAT_RES, method="linear"
+    )
+    return {
+        "mask_cov": mask.mean(axis=(2, 3)),
+        "mask_heat": pooled,
+        "blend_active": (step_index >= blend_cfg.start_blend).astype(jnp.int32),
+    }
+
+
+def _pack_step_outputs(telemetry, tel, attn_maps, attn):
+    """Scan ``ys`` for the optional observability channels (None when both
+    are off, so the off-path scan is the exact pre-observability scan)."""
+    ys = {}
+    if telemetry:
+        ys["tel"] = tel
+    if attn_maps:
+        ys["attn"] = attn
+    return ys or None
 
 
 def make_unet_fn(model) -> UNetFn:
@@ -101,6 +128,7 @@ def edit_sample(
     null_uncond_embeddings: Optional[jax.Array] = None,
     cached_source: Optional[CachedSource] = None,
     telemetry: bool = False,
+    attn_maps: bool = False,
 ) -> jax.Array:
     """Run the controlled denoise loop; returns final latents (P, F, h, w, C).
 
@@ -134,6 +162,15 @@ def edit_sample(
     self/temporal replacement window was active. Off by default; the
     telemetry-off program is unchanged (tests/test_obs.py pins the outputs
     bit-exact, cached replay exactness included).
+
+    ``attn_maps=True``: additionally return a per-step attention capture
+    record riding the same scan (obs.attention — zero extra dispatches):
+    pooled per-token cross-attention heatmaps over the conditional
+    streams, per-site attention entropies, and (when a LocalBlend is
+    configured) the blend-mask time series with coverage fractions. The
+    return is ``latents`` plus the requested records in fixed order:
+    ``(latents[, tel][, attn])``. Off by default — the capture-off
+    program is byte-identical (tests/test_quality.py pins it).
     """
     P = cond_embeddings.shape[0]
     multi = cond_embeddings.ndim == 4
@@ -193,7 +230,7 @@ def edit_sample(
             uncond_embeddings, cached_source,
             num_inference_steps=num_inference_steps,
             guidance_scale=guidance_scale, ctx=ctx,
-            blend_res=blend_res, telemetry=telemetry,
+            blend_res=blend_res, telemetry=telemetry, attn_maps=attn_maps,
         )
 
     # the source stream's per-step uncond: the null-text sequence when given,
@@ -325,16 +362,27 @@ def edit_sample(
             latents = jnp.where(
                 active, jnp.broadcast_to(latents[:1], latents.shape), latents
             )
-        ys = None
+        tel = attn = None
         if telemetry:
-            ys = dict(latent_stats(latents), **_controller_gates(ctx, i))
+            tel = dict(latent_stats(latents), **_controller_gates(ctx, i))
+        if attn_maps:
+            attn = attn_step_record(
+                store, num_uncond=U, num_cond=P, video_length=video_length,
+                text_len=text_len, latent_hw=latent_hw,
+            )
+            if use_blend:
+                attn.update(_mask_series_entry(maps_sum, ctx.blend, i, latent_hw))
+        ys = _pack_step_outputs(telemetry, tel, attn_maps, attn)
         return (latents, maps_sum, key), ys
 
     xs = (timesteps, jnp.arange(num_inference_steps), uncond0_seq)
-    (latents, _, _), tel = jax.lax.scan(body, (latents, maps_sum, key), xs)
+    (latents, _, _), ys = jax.lax.scan(body, (latents, maps_sum, key), xs)
+    out = (latents,)
     if telemetry:
-        return latents, tel
-    return latents
+        out += (ys["tel"],)
+    if attn_maps:
+        out += (ys["attn"],)
+    return out if len(out) > 1 else latents
 
 
 def _edit_sample_cached(
@@ -351,6 +399,7 @@ def _edit_sample_cached(
     ctx: Optional[ControlContext],
     blend_res: Optional[Tuple[int, int]],
     telemetry: bool = False,
+    attn_maps: bool = False,
 ) -> jax.Array:
     """The cached-source denoise loop: only the P−1 edit streams run the
     UNet; the source stream is read off the reversed inversion trajectory
@@ -474,11 +523,22 @@ def _edit_sample_cached(
                 jnp.broadcast_to(src_after, edit_latents.shape),
                 edit_latents,
             )
-        ys = None
+        tel = attn = None
         if telemetry:
             # stats cover the EDIT streams only — the source stream is a
             # replayed constant here, by construction finite and exact
-            ys = dict(latent_stats(edit_latents), **_controller_gates(ctx, i))
+            tel = dict(latent_stats(edit_latents), **_controller_gates(ctx, i))
+        if attn_maps:
+            # heat covers the E edit streams (the source stream is not in
+            # the batch — its maps live in the inversion capture record);
+            # the mask series keeps all 1+E streams, source first
+            attn = attn_step_record(
+                store, num_uncond=U, num_cond=E, video_length=video_length,
+                text_len=text_len, latent_hw=latent_hw,
+            )
+            if use_blend:
+                attn.update(_mask_series_entry(maps_sum, ctx.blend, i, latent_hw))
+        ys = _pack_step_outputs(telemetry, tel, attn_maps, attn)
         return (edit_latents, maps_sum), ys
 
     blend_xs = (
@@ -487,12 +547,15 @@ def _edit_sample_cached(
         else jnp.zeros((num_inference_steps, 0))
     )
     xs = (timesteps, jnp.arange(num_inference_steps), src_seq, blend_xs)
-    (edit_latents, _), tel = jax.lax.scan(body, (edit_latents, maps_sum), xs)
+    (edit_latents, _), ys = jax.lax.scan(body, (edit_latents, maps_sum), xs)
     # stream 0 = the exact inversion reconstruction (trajectory[0] = x_0)
     out = jnp.concatenate([cached.src_latents[-1], edit_latents], axis=0)
+    outs = (out,)
     if telemetry:
-        return out, tel
-    return out
+        outs += (ys["tel"],)
+    if attn_maps:
+        outs += (ys["attn"],)
+    return outs if len(outs) > 1 else out
 
 
 def official_edit(

@@ -103,7 +103,14 @@ def phase_timer(
 
 @contextlib.contextmanager
 def trace(name: str) -> Iterator[None]:
-    """jax.profiler trace when VIDEOP2P_TRACE_DIR is set, else a no-op."""
+    """jax.profiler trace when VIDEOP2P_TRACE_DIR is set, else a no-op.
+
+    With an active :class:`~videop2p_tpu.obs.ledger.RunLedger`, a
+    ``trace`` event (name + trace directory) is emitted once the region
+    closes — so ``ledger_summary``/the edit report can link the device
+    trace to the phase that produced it instead of the path living only
+    in the operator's shell history.
+    """
     trace_dir = os.environ.get("VIDEOP2P_TRACE_DIR")
     if not trace_dir:
         with phase_timer(name):
@@ -111,6 +118,15 @@ def trace(name: str) -> Iterator[None]:
         return
     import jax
 
-    with jax.profiler.trace(os.path.join(trace_dir, name)):
+    target = os.path.join(trace_dir, name)
+    with jax.profiler.trace(target):
         with phase_timer(name):
             yield
+    try:
+        from videop2p_tpu.obs.ledger import current_ledger
+
+        led = current_ledger()
+    except Exception:  # noqa: BLE001 — observability never breaks tracing
+        led = None
+    if led is not None:
+        led.event("trace", name=name, trace_dir=target)
