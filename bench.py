@@ -979,10 +979,15 @@ def main() -> None:
     # persistent compile cache the AOT re-lowering is cheap.
     if os.environ.get("VIDEOP2P_BENCH_CPU_ANALYSIS", "1") == "1":
         try:
-            from videop2p_tpu.obs.introspect import analyze_jitted
+            from videop2p_tpu.obs.comm import comm_analysis_record
+            from videop2p_tpu.obs.introspect import (
+                analyze_compiled,
+                compile_abstract,
+            )
             from videop2p_tpu.obs.ledger import suppress_compile_events
 
             analyses = {}
+            comm_records = {}
             with suppress_compile_events():
                 for name, (fn_j, a) in {
                     "invert_captured": (wp.invert_captured, (params, x0)),
@@ -990,13 +995,28 @@ def main() -> None:
                                     (params, traj[-1], cached_src)),
                     "e2e_cached": (wp.e2e_cached, (params, x0)),
                 }.items():
-                    a_rec = analyze_jitted(fn_j, *a)
+                    compiled = compile_abstract(fn_j, *a)
+                    if compiled is None:
+                        continue
+                    a_rec = analyze_compiled(compiled)
                     if a_rec:
                         analyses[name] = a_rec
                         bench_ledger.program_analysis(name, a_rec)
+                    # collective accounting (obs/comm.py) — meaningful only
+                    # for partitioned programs; single-chip benches record
+                    # nothing here (no collectives, one partition)
+                    c_rec = comm_analysis_record(compiled)
+                    if c_rec is not None and (
+                        c_rec.get("num_partitions", 1) > 1
+                        or c_rec.get("collective_count", 0)
+                    ):
+                        comm_records[name] = c_rec
+                        bench_ledger.comm_analysis(name, c_rec)
             record_program_analyses(
                 rec, analyses, backend=jax.devices()[0].platform
             )
+            if comm_records:
+                rec.record("comm_analysis", comm_records)
         except Exception as e:  # noqa: BLE001 — evidence, never the record
             print(f"[bench] program analysis failed: {e}", file=sys.stderr,
                   flush=True)

@@ -427,6 +427,67 @@ def test_quality_and_attn_ledger_event_schema(tmp_path):
     assert {"recon_psnr_frames", "background_psnr_frames"} <= set(curves)
 
 
+def test_comm_and_device_ledger_event_schema(tmp_path):
+    """Schema pin (ISSUE 5): the ``comm_analysis`` / ``device_telemetry`` /
+    per-device ``memory`` / ``divergence`` ledger events carry their
+    documented field sets — obs/history.py rules, both tools and the HTML
+    report key on these names."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from videop2p_tpu.obs import RunLedger, read_ledger
+    from videop2p_tpu.obs.comm import (
+        COMM_ANALYSIS_FIELDS,
+        DEVICE_TELEMETRY_FIELDS,
+        comm_analysis_record,
+        summarize_device_stats,
+    )
+    from videop2p_tpu.parallel import make_mesh
+
+    # a minimal partitioned program: the sharded sum's partial results
+    # meet in an all-reduce, so the record has real collectives in it
+    mesh = make_mesh((1, 8, 1))
+    sds = jax.ShapeDtypeStruct(
+        (16, 16), jnp.float32, sharding=NamedSharding(mesh, P("frames"))
+    )
+    comm_rec = comm_analysis_record(jax.jit(lambda x: x.sum()).lower(sds).compile())
+    assert comm_rec is not None
+    assert set(COMM_ANALYSIS_FIELDS) <= set(comm_rec)
+    assert comm_rec["num_partitions"] == 8
+    assert comm_rec["collective_count"] >= 1
+
+    dev_rec = summarize_device_stats({
+        "device_abs_max": np.ones((3, 8)),
+        "device_mean": np.zeros((3, 8)),
+        "device_nan_count": np.zeros((3, 8)),
+        "device_inf_count": np.zeros((3, 8)),
+        "divergence": np.zeros(3),
+    }, device_ids=list(range(8)))
+    assert set(DEVICE_TELEMETRY_FIELDS) <= set(dev_rec)
+
+    path = str(tmp_path / "ledger.jsonl")
+    with RunLedger(path) as led:
+        led.comm_analysis("p", comm_rec)
+        led.device_telemetry("p", dev_rec)
+        led.divergence("train_params", 0.0, axes=["data"])
+        led.memory_snapshot(note="pin")
+    by_kind = {e["event"]: e for e in read_ledger(path)}
+    c = by_kind["comm_analysis"]
+    assert set(COMM_ANALYSIS_FIELDS) <= set(c) and c["program"] == "p"
+    assert set(DEVICE_TELEMETRY_FIELDS) <= set(by_kind["device_telemetry"])
+    v = by_kind["divergence"]
+    assert v["label"] == "train_params" and v["value"] == 0.0
+    # memory snapshots list EVERY local device (8 on the virtual CPU mesh)
+    # with a stable per-entry schema even where memory_stats is missing
+    m = by_kind["memory"]
+    assert len(m["devices"]) == len(jax.local_devices())
+    for entry in m["devices"]:
+        assert {"device", "coords", "process_index", "bytes_in_use",
+                "peak_bytes_in_use", "bytes_limit", "live_bytes"} <= set(entry)
+
+
 def test_no_wall_clock_in_timed_regions():
     """Satellite guard (ISSUE 2): every timed region in the package uses
     the monotonic clock — ``time.time()`` steps under NTP adjustment and
@@ -547,6 +608,39 @@ def test_dryrun_runs_inline_when_already_on_a_big_cpu_mesh(graft, monkeypatch):
     monkeypatch.setattr(graft, "_dryrun_impl", lambda n: ran.setdefault("n", n))
     graft.dryrun_multichip(8)
     assert ran["n"] == 8
+
+
+@pytest.mark.slow
+def test_dryrun_writes_obs_ledger_acceptance(graft, tmp_path, monkeypatch):
+    """The ISSUE 5 acceptance criterion, end to end on the in-process
+    8-device CPU mesh: the dryrun writes dryrun_ledger.jsonl with ≥1
+    comm_analysis event carrying nonzero collective bytes, a per-device
+    memory snapshot, and passing divergence verdicts; obs_diff self-compare
+    exits 0 and an injected +20% collective-bytes delta exits 1 with a
+    machine-readable comm verdict."""
+    ledger = str(tmp_path / "dryrun_ledger.jsonl")
+    monkeypatch.setenv("VIDEOP2P_DRYRUN_LEDGER", ledger)
+    graft._dryrun_impl(8)
+
+    events = [json.loads(l) for l in open(ledger) if l.strip()]
+    comm = [e for e in events if e["event"] == "comm_analysis"]
+    assert any(e["collective_bytes"] > 0 for e in comm)
+    assert any(e["event"] == "memory" and e.get("devices") for e in events)
+    divs = [e for e in events if e["event"] == "divergence"]
+    assert divs and all(e["value"] == 0.0 for e in divs)
+    dev = [e for e in events if e["event"] == "device_telemetry"]
+    assert dev and all(e["divergence_max"] == 0.0 for e in dev)
+
+    obs_diff = _load_module("obs_diff_under_graft_test", "tools/obs_diff.py")
+    assert obs_diff.main(["obs_diff.py", ledger, ledger]) == 0
+    # inject +20% collective bytes into a copy → nonzero exit + verdict
+    perturbed = str(tmp_path / "perturbed.jsonl")
+    with open(perturbed, "w") as f:
+        for e in events:
+            if e["event"] == "comm_analysis":
+                e = dict(e, collective_bytes=int(e["collective_bytes"] * 1.2))
+            f.write(json.dumps(e) + "\n")
+    assert obs_diff.main(["obs_diff.py", ledger, perturbed]) == 1
 
 
 @pytest.mark.slow

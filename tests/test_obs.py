@@ -687,6 +687,49 @@ def test_program_analysis_kill_switch_and_ledger_off(tmp_path, monkeypatch):
     assert "program_analysis" not in kinds
 
 
+def test_program_analysis_skip_is_an_event_not_silence(tmp_path, monkeypatch):
+    """ISSUE 5 satellite: when the automatic analysis is disabled or cannot
+    run, the ledger records a program_analysis_skipped event with the
+    reason — a missing record is a statement, never a silent drop."""
+    path = str(tmp_path / "ledger.jsonl")
+    f = instrumented_jit(lambda x: x + 1, program="adder", analyze=False)
+    with RunLedger(path):
+        f(jnp.asarray(1.0))
+
+    def skips(p):
+        return [(e["program"], e["reason"]) for e in read_ledger(p)
+                if e["event"] == "program_analysis_skipped"]
+
+    assert skips(path) == [("adder", "analyze_false")]
+    # the process-wide kill-switch states its reason too
+    monkeypatch.setenv("VIDEOP2P_OBS_NO_ANALYSIS", "1")
+    path2 = str(tmp_path / "ledger2.jsonl")
+    g = instrumented_jit(lambda x: x + 2, program="adder2")
+    with RunLedger(path2):
+        g(jnp.asarray(1.0))
+    assert skips(path2) == [("adder2", "disabled")]
+    monkeypatch.delenv("VIDEOP2P_OBS_NO_ANALYSIS")
+    # a failing lower/compile behind an otherwise-working call: the call
+    # succeeds, the skip event lands with the failure reason
+    from videop2p_tpu.obs import introspect as introspect_mod
+
+    path3 = str(tmp_path / "ledger3.jsonl")
+    h = instrumented_jit(lambda x: x * 2, program="flaky")
+    with monkeypatch.context() as m:
+        m.setattr(introspect_mod, "compile_abstract", lambda *a, **kw: None)
+        with RunLedger(path3):
+            out = h(jnp.asarray(3.0))
+    assert float(out) == 6.0
+    assert skips(path3) == [("flaky", "lower_or_compile_failed")]
+    # skipped events never fire on a healthy analyzed program
+    path4 = str(tmp_path / "ledger4.jsonl")
+    k = instrumented_jit(lambda x: x * 3, program="ok")
+    with RunLedger(path4):
+        k(jnp.asarray(1.0))
+    assert skips(path4) == []
+    assert any(e["event"] == "program_analysis" for e in read_ledger(path4))
+
+
 def test_null_text_programs_emit_analysis(problem, sched, tmp_path):
     """The pipelines' internal jits (fused + chunked null-text) are
     instrumented where the CLI's wrappers cannot reach — both land

@@ -348,6 +348,71 @@ def test_sharded_cached_source_edit_matches_unsharded(mesh8):
     np.testing.assert_array_equal(np.asarray(out28[0]), np.asarray(s_x0[0]))
 
 
+def test_sharded_group_norm_matches_reference(mesh8):
+    """The shard_map GroupNorm wrapper (VERDICT r5 next-round #5): the
+    fused one-pass kernel runs per-shard on sample-split slabs and must
+    match the two-pass reference — directly and through the TpuGroupNorm
+    ``group_norm_fn`` seam; uncovered sites return None (→ XLA fallback)."""
+    from videop2p_tpu.models.layers import TpuGroupNorm
+    from videop2p_tpu.ops.groupnorm import group_norm_reference
+    from videop2p_tpu.parallel import make_sharded_group_norm_fn
+
+    fn = make_sharded_group_norm_fn(mesh8, impl="interpret")
+    N, rows, C = 8, 256, 32  # 8 samples over 8 shards, VMEM-sized slab
+    x2 = jax.random.normal(jax.random.key(0), (N, rows, C))
+    scale = jax.random.normal(jax.random.key(1), (C,))
+    bias = jax.random.normal(jax.random.key(2), (C,))
+    y = fn(x2, scale, bias, num_groups=4, eps=1e-5, act="silu")
+    assert y is not None
+    ref = group_norm_reference(x2, scale, bias, num_groups=4, eps=1e-5,
+                               act="silu")
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), atol=2e-5)
+
+    # uncovered sites: sample axis not divisible by the shard count (the
+    # frame-pooled resnet slabs), or a slab the VMEM gate refuses — None,
+    # and the caller falls back to the two-pass math
+    assert fn(x2[:3], scale, bias, num_groups=4, eps=1e-5, act="none") is None
+    odd = jax.random.normal(jax.random.key(3), (8, 100, 32))
+    assert fn(odd, scale, bias, num_groups=4, eps=1e-5, act="none") is None
+    # an impl that disables the kernel covers nothing
+    off = make_sharded_group_norm_fn(mesh8, impl="xla")
+    assert off(x2, scale, bias, num_groups=4, eps=1e-5, act="none") is None
+
+    # through the module seam, jitted with the sample axis sharded:
+    # sharded == unsharded with the kernel active in interpret mode
+    gn = TpuGroupNorm(num_groups=4, epsilon=1e-5, act="silu",
+                      group_norm_fn=fn)
+    x = jax.random.normal(jax.random.key(4), (N, 16, 16, C))
+    variables = gn.init(jax.random.key(5), x)
+    ref_mod = TpuGroupNorm(num_groups=4, epsilon=1e-5, act="silu", impl="xla")
+    y_ref = jax.jit(ref_mod.apply)(variables, x)
+    s_x = jax.device_put(
+        x, NamedSharding(mesh8, P(("data", "frames"), None, None, None))
+    )
+    y_sharded = jax.jit(gn.apply)(jax.device_put(variables, replicated(mesh8)),
+                                  s_x)
+    np.testing.assert_allclose(
+        np.asarray(y_ref), np.asarray(y_sharded), atol=2e-5
+    )
+
+
+def test_setup_mesh_wires_sharded_group_norm():
+    """setup_mesh no longer forces group_norm='xla' on sharded meshes — it
+    wires the shard_map GroupNorm seam instead, leaving the config knob
+    untouched (the kernel decision now lives in the seam)."""
+    import jax.numpy as jnp
+
+    from videop2p_tpu.cli.common import build_models, setup_mesh
+
+    bundle = build_models(None, tiny=True, dtype=jnp.float32)
+    assert bundle.unet.config.group_norm == "auto"
+    assert bundle.unet.group_norm_fn is None
+    mesh = setup_mesh(bundle, "1,4,2", 8)
+    assert mesh.shape == {"data": 1, "frames": 4, "tensor": 2}
+    assert bundle.unet.group_norm_fn is not None
+    assert bundle.unet.config.group_norm == "auto"  # knob not clobbered
+
+
 def test_hybrid_mesh_single_slice_and_distributed_noop():
     """make_hybrid_mesh on one slice equals the plain reshape;
     initialize_distributed is a no-op without multi-host config."""

@@ -10,6 +10,13 @@ hit/miss counts), a per-program XLA cost/memory-analysis table
 fingerprint) with a predicted-vs-measured peak-HBM line when memory
 snapshots exist, telemetry summaries with a loss-curve sparkline for the
 fused null-text program, training-metric and memory-snapshot digests.
+Distributed runs additionally get a collective-communication table
+(``comm_analysis`` events — obs/comm.py per-kind counts/bytes), per-device
+telemetry lines with the cross-replica divergence (must be 0.0),
+``program_analysis_skipped`` reasons, and a per-host phase-skew table when
+``host_phase`` events exist (multi-host straggler visibility). Ledgers
+written before these events existed render exactly as before — the
+sections simply don't appear.
 
 Tolerates empty ledgers and truncated/partial JSONL lines (a killed run's
 torn tail): malformed events render as far as their fields allow instead
@@ -127,6 +134,80 @@ def render(events: List[Dict]) -> str:
                 _table(rows, ["program", "flops", "bytes", "temp",
                               "peak_hbm", "instrs", "hlo_fingerprint"])]
 
+    # comm_analysis: collective accounting of the sharded programs
+    # (obs/comm.py) — static per-module counts/bytes, keyed like the
+    # program-analysis table
+    comms: Dict[str, Dict] = {}
+    for e in events:
+        if e.get("event") == "comm_analysis":
+            comms[e.get("program") or "(unattributed)"] = e
+    if comms:
+        rows = []
+        for prog, c in sorted(comms.items()):
+            per_kind = c.get("per_kind") or {}
+            kinds = ", ".join(
+                f"{k}×{v.get('count', '?')}" for k, v in sorted(per_kind.items())
+                if isinstance(v, dict)
+            ) or "-"
+            rows.append([
+                prog, str(c.get("num_partitions", "-")),
+                str(c.get("collective_count", "-")),
+                _mb(c.get("collective_bytes")), kinds,
+            ])
+        out += ["", "collectives (comm_analysis — static counts/bytes of "
+                "the partitioned programs):",
+                _table(rows, ["program", "partitions", "collectives",
+                              "bytes", "per-kind"])]
+
+    skipped: Dict[str, str] = {}
+    for e in events:
+        if e.get("event") == "program_analysis_skipped":
+            skipped[e.get("program") or "(unattributed)"] = str(
+                e.get("reason", "?")
+            )
+    if skipped:
+        out += ["", "program analysis skipped:"] + [
+            f"  {prog}: {reason}" for prog, reason in sorted(skipped.items())
+        ]
+
+    dev_lines: List[str] = []
+    for e in events:
+        if e.get("event") != "device_telemetry":
+            continue
+        div = e.get("divergence_max")
+        peaks = e.get("per_device_abs_max_peak") or []
+        line = (f"  {e.get('program', '?')}: {e.get('devices', '?')} devices"
+                f", divergence max {div} / final {e.get('divergence_final')}"
+                f", NaN {e.get('nan_total', 0)}")
+        if peaks:
+            line += (f", abs_max peak spread "
+                     f"[{min(map(_f, peaks)):.4g}, {max(map(_f, peaks)):.4g}]")
+        if _f(div):
+            line += "  <-- REPLICAS DIVERGED (must be 0.0)"
+        dev_lines.append(line)
+    for e in events:
+        if e.get("event") != "divergence":
+            continue
+        val = _f(e.get("value"))
+        dev_lines.append(
+            f"  {e.get('label', '?')}: divergence {e.get('value')}"
+            + ("  <-- REPLICAS DIVERGED (must be 0.0)" if val else "")
+        )
+    if dev_lines:
+        out += ["", "per-device telemetry / replica divergence:"] + dev_lines
+
+    host_phases = [e for e in events if e.get("event") == "host_phase"]
+    if host_phases:
+        # the skew math lives next to the event producer
+        from videop2p_tpu.parallel.distributed import phase_skew
+
+        rows = [[name, s["hosts"], f"{s['min_s']:.2f}", f"{s['max_s']:.2f}",
+                 f"{s['skew_s']:.2f}", s["slowest_process"]]
+                for name, s in sorted(phase_skew(host_phases).items())]
+        out += ["", "per-host phase skew (straggler visibility):",
+                _table(rows, ["phase", "hosts", "min_s", "max_s",
+                              "skew_s", "slowest_proc"])]
+
     tel_lines: List[str] = []
     for e in events:
         if e.get("event") != "telemetry":
@@ -212,6 +293,19 @@ def render(events: List[Dict]) -> str:
         )
         out += ["", f"memory: {len(mems)} snapshots, peak "
                 f"{peak / 2**30:.2f} GiB in use"]
+        # per-device residency: worst peak per device id across snapshots
+        # (sharded runs — one line only when >1 device reported stats)
+        per_dev: Dict[str, float] = {}
+        for e in mems:
+            for d in e.get("devices") or []:
+                if isinstance(d, dict) and d.get("peak_bytes_in_use") is not None:
+                    key = f"device{d.get('device')}"
+                    per_dev[key] = max(per_dev.get(key, 0.0),
+                                       _f(d.get("peak_bytes_in_use")))
+        if len(per_dev) > 1:
+            out.append("  per-device peak: " + ", ".join(
+                f"{k}={v / 2**30:.2f}G" for k, v in sorted(per_dev.items())
+            ))
         # predicted-vs-measured: the largest per-program peak-HBM estimate
         # (XLA memory_analysis) against the device's measured peak — the
         # HBM-gate sanity line (predicted covers ONE program's residency;

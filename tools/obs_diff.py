@@ -6,10 +6,14 @@ Usage:
   python tools/obs_diff.py --history DIR            # latest vs its baseline
 
 Compares the ``program_analysis`` events (XLA cost/memory analysis, HLO
-fingerprints — obs/introspect.py), per-program compile seconds, and phase
-wall-clock between a baseline run and a new run, renders per-program
-tables, evaluates the declarative regression rules (obs/history.py
-DEFAULT_RULES; scale every threshold with ``--threshold-scale``), and:
+fingerprints — obs/introspect.py), per-program compile seconds, phase
+wall-clock, collective-communication accounting (``comm_analysis`` events
+— obs/comm.py: per-kind collective counts and byte volumes of the sharded
+programs), per-device peak-HBM residency (``memory`` snapshots), and
+cross-replica divergence (must be 0.0 — the zero-noise-floor invariant)
+between a baseline run and a new run, renders per-program tables,
+evaluates the declarative regression rules (obs/history.py DEFAULT_RULES;
+scale every threshold with ``--threshold-scale``), and:
 
   exit 0 — no rule regressed (a ledger compared against itself is always 0)
   exit 1 — at least one regression verdict
@@ -23,6 +27,7 @@ gate for "did this change make the compiled programs bigger".
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import os
 import sys
@@ -118,6 +123,58 @@ def render_diff(base: Dict, new: Dict, result: Dict) -> str:
         out += ["", "phases (wall-clock s):",
                 _table(rows, ["phase", "base", "new", "delta"])]
 
+    # distributed sections (obs/comm.py) — absent/empty for pre-PR-5
+    # ledgers and single-device runs, in which case the tables are omitted
+    comms = sorted(set(base.get("comm") or {}) | set(new.get("comm") or {}))
+    if comms:
+        rows = []
+        for label in comms:
+            b = (base.get("comm") or {}).get(label, {})
+            n = (new.get("comm") or {}).get(label, {})
+
+            def ccell(metric, b=b, n=n):
+                bv, nv = b.get(metric), n.get(metric)
+                if bv is None and nv is None:
+                    return "-"
+                if bv is None or nv is None:
+                    return f"{_fmt(bv)} → {_fmt(nv)}"
+                if bv == nv:
+                    return _fmt(nv)
+                pct = (nv / bv - 1.0) * 100.0 if bv else float("inf")
+                return f"{_fmt(bv)} → {_fmt(nv)} ({pct:+.1f}%)"
+
+            rows.append([label, ccell("num_partitions"),
+                         ccell("collective_count"), ccell("collective_bytes")])
+        out += ["", "collectives (comm_analysis — static per-module "
+                "counts/bytes):",
+                _table(rows, ["program", "partitions", "collectives",
+                              "bytes"])]
+
+    devmem = sorted(set(base.get("device_memory") or {})
+                    | set(new.get("device_memory") or {}))
+    if devmem:
+        rows = []
+        for dev in devmem:
+            b = (base.get("device_memory") or {}).get(dev)
+            n = (new.get("device_memory") or {}).get(dev)
+            delta = (f"{(n / b - 1.0) * 100.0:+.1f}%"
+                     if b and n is not None else "-")
+            rows.append([dev, _fmt(b), _fmt(n), delta])
+        out += ["", "per-device peak HBM (memory snapshots):",
+                _table(rows, ["device", "base", "new", "delta"])]
+
+    divs = sorted(set(base.get("divergence") or {})
+                  | set(new.get("divergence") or {}))
+    if divs:
+        rows = []
+        for label in divs:
+            b = (base.get("divergence") or {}).get(label)
+            n = (new.get("divergence") or {}).get(label)
+            rows.append([label, _fmt(b), _fmt(n),
+                         "ok" if n in (None, 0.0) else "DIVERGED"])
+        out += ["", "replica divergence (must be 0.0):",
+                _table(rows, ["label", "base", "new", "verdict"])]
+
     comp = sorted(set(base.get("compiles", {})) | set(new.get("compiles", {})))
     if comp:
         rows = []
@@ -207,10 +264,14 @@ def main(argv: List[str]) -> int:
         if base is None or new is None:
             return 2
 
+    # dataclasses.replace keeps every other field — notably `direction`:
+    # rebuilding by hand once dropped it, silently flipping the
+    # decrease-direction quality rules and the nonzero divergence invariant
+    # back to increase-threshold semantics
     rules = tuple(
-        RegressionRule(r.metric, kind=r.kind,
-                       threshold_pct=r.threshold_pct * args.threshold_scale,
-                       min_abs=r.min_abs, programs=r.programs)
+        dataclasses.replace(
+            r, threshold_pct=r.threshold_pct * args.threshold_scale
+        )
         for r in DEFAULT_RULES
     )
     result = evaluate_rules(base, new, rules)

@@ -62,6 +62,7 @@ def setup_mesh(bundle: "ModelBundle", mesh_spec: str, video_len: int):
         make_mesh,
         make_ring_temporal_fn,
         make_sharded_frame_attention_fn,
+        make_sharded_group_norm_fn,
         param_shardings,
     )
 
@@ -80,13 +81,16 @@ def setup_mesh(bundle: "ModelBundle", mesh_spec: str, video_len: int):
     print(f"[mesh] data={dp} frames={sp} tensor={tp}")
     if sp > 1 or tp > 1:
         # a model-internal axis is sharded: pjit cannot partition Pallas
-        # custom calls, so force the XLA GroupNorm path (the fused kernel
-        # is the single-chip default; the sharded frame-attention sites get
-        # their own shard_map-wrapped kernel below)
-        import dataclasses as _dc
-
+        # custom calls, so the fused GroupNorm reaches the mesh through the
+        # model's group_norm_fn seam instead of the naked kernel — the same
+        # shard_map wrapper pattern as the sharded frame attention below.
+        # Sites the wrapper does not cover (frame-pooled resnet slabs whose
+        # statistics cross frame shards, slabs over the VMEM gate) fall
+        # back to the two-pass XLA math GSPMD partitions as before.
         bundle.unet = bundle.unet.clone(
-            config=_dc.replace(bundle.unet.config, group_norm="xla")
+            group_norm_fn=make_sharded_group_norm_fn(
+                device_mesh, impl=bundle.unet.config.group_norm
+            )
         )
     if sp > 1:
         # ring attention on the uncontrolled temporal sites (training /
@@ -169,6 +173,15 @@ def add_obs_args(parser: argparse.ArgumentParser) -> None:
              "(cost/memory analysis + HLO fingerprint per instrumented "
              "program on each compile) — it re-lowers each program "
              "ahead-of-time, which is persistent-cache-cheap but not free",
+    )
+    parser.add_argument(
+        "--device_telemetry", action="store_true",
+        help="per-device observability on sharded runs (obs/comm.py): "
+             "per-device latent abs-max/mean/NaN stats and a cross-replica "
+             "divergence scalar riding the fused scans via a shard_map "
+             "probe, per-device memory snapshots, and divergence ledger "
+             "events gated by the zero-noise-floor COMM_RULES verdict — "
+             "requires --mesh; implies a run ledger",
     )
     parser.add_argument(
         "--attn_maps", action="store_true",

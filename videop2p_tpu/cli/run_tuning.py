@@ -100,6 +100,11 @@ def main(
     # riding the train scan + a JSONL run ledger
     telemetry: bool = False,
     ledger: Optional[str] = None,
+    # distributed observability (ISSUE 5, obs/comm.py): after training,
+    # measure the cross-replica divergence of the tuned params over the
+    # mesh axes they are replicated on — the invariant a desynced replica
+    # breaks silently — and ledger it (divergence must be 0.0; COMM_RULES)
+    device_telemetry: bool = False,
     # automatic XLA cost/memory analysis of each instrumented program on
     # compile (program_analysis ledger events; obs/introspect.py)
     program_analysis: bool = True,
@@ -124,14 +129,15 @@ def main(
     # unified run record (videop2p_tpu/obs): phases, compile events, train
     # metrics and telemetry land in one JSONL stream, line-flushed
     run_ledger = None
-    if telemetry or ledger:
+    if telemetry or ledger or device_telemetry:
         from videop2p_tpu.obs import RunLedger
 
         run_ledger = RunLedger(
             ledger or os.path.join(output_dir, "run_ledger.jsonl"),
             mesh=mesh,
             meta={"cli": "run_tuning", "max_train_steps": max_train_steps,
-                  "telemetry": bool(telemetry)},
+                  "telemetry": bool(telemetry),
+                  "device_telemetry": bool(device_telemetry)},
         ).activate()
 
     sampler = None
@@ -308,6 +314,25 @@ def main(
     metrics.close()
     if run_ledger is not None:
         run_ledger.memory_snapshot(note="after_training")
+    if device_telemetry and mesh:
+        # the tuned params must be IDENTICAL on every mesh replica (dp=1
+        # single-clip tuning replicates non-tensor-parallel params over the
+        # whole mesh); a nonzero divergence means a replica desynced — the
+        # ledger event joins the zero-noise-floor COMM_RULES gate
+        from videop2p_tpu.obs.comm import tree_replica_divergence
+
+        div_axes = tuple(
+            a for a in device_mesh.axis_names if device_mesh.shape[a] > 1
+        )
+        if div_axes:
+            div = float(tree_replica_divergence(
+                state.params, device_mesh, axes=div_axes
+            ))
+            if run_ledger is not None:
+                run_ledger.divergence("params_after_training", div,
+                                      axes=list(div_axes))
+            print(f"[tune] param replica divergence over {div_axes}: {div}"
+                  + ("  <-- REPLICAS DIVERGED (must be 0.0)" if div else ""))
 
     save_pipeline(
         output_dir,
@@ -422,4 +447,5 @@ if __name__ == "__main__":
         telemetry=args.telemetry,
         ledger=args.ledger,
         program_analysis=not args.no_program_analysis,
+        device_telemetry=args.device_telemetry,
     )

@@ -72,6 +72,25 @@ def _word_token_records(prompts: Sequence[str], tokenizer) -> list:
     return recs
 
 
+def _ledger_device_stats(run_ledger, program, dev_stats, probe) -> None:
+    """Summarize one scan's device-probe channels into a
+    ``device_telemetry`` ledger event (+ a console warning when the
+    replicas diverged — divergence joins the zero-noise-floor COMM_RULES
+    gate via obs/history.py)."""
+    from videop2p_tpu.obs import summarize_device_stats
+
+    rec = summarize_device_stats(dev_stats, probe.device_ids)
+    rec["divergence_axes"] = list(probe.divergence_axes)
+    if run_ledger is not None:
+        run_ledger.device_telemetry(program, rec)
+    div = rec.get("divergence_max", 0.0)
+    line = (f"[p2p] device telemetry ({program}): {rec.get('devices')} "
+            f"devices, divergence_max={div}")
+    if div:
+        line += "  <-- REPLICAS DIVERGED (must be 0.0)"
+    print(line)
+
+
 def _semantic_obs(
     run_ledger,
     *,
@@ -249,6 +268,13 @@ def main(
     attn_maps: bool = False,
     quality: bool = False,
     report: bool = False,
+    # distributed observability (ISSUE 5, obs/comm.py): a shard_map probe
+    # riding the fused edit scan records per-device latent stats and a
+    # cross-replica divergence scalar (device_telemetry ledger events —
+    # divergence must be 0.0, gated by the zero-noise-floor COMM_RULES);
+    # requires --mesh. comm_analysis events (collective counts/bytes) come
+    # free with program_analysis on sharded programs.
+    device_telemetry: bool = False,
     # automatic XLA cost/memory analysis of each instrumented program on
     # compile (program_analysis ledger events; obs/introspect.py) — the
     # per-program peak-HBM estimate the memory snapshots are checked
@@ -285,7 +311,7 @@ def main(
     # telemetry summary and memory snapshot below lands in ONE JSONL stream
     # (events are line-flushed, so a killed run keeps what it measured)
     run_ledger = None
-    if telemetry or ledger or attn_maps or quality or report:
+    if telemetry or ledger or attn_maps or quality or report or device_telemetry:
         from videop2p_tpu import obs
 
         run_ledger = obs.RunLedger(
@@ -295,6 +321,7 @@ def main(
                   "prompt": prompt, "prompts": list(prompts),
                   "telemetry": bool(telemetry),
                   "attn_maps": bool(attn_maps), "quality": bool(quality),
+                  "device_telemetry": bool(device_telemetry),
                   "null_text_precision": null_text_precision},
         ).activate()
 
@@ -328,6 +355,20 @@ def main(
         gradient_checkpointing=not fast,
     )
     device_mesh = setup_mesh(bundle, mesh, video_len) if mesh else None
+
+    # the per-device probe needs a mesh to shard_map over; single-device
+    # runs have no replicas to diverge, so the flag degrades to a note
+    device_probe = None
+    if device_telemetry:
+        if device_mesh is not None:
+            from videop2p_tpu.obs import make_device_probe
+
+            device_probe = make_device_probe(device_mesh)
+            print(f"[p2p] device telemetry: probing {device_mesh.size} "
+                  f"devices, divergence over {device_probe.divergence_axes}")
+        else:
+            print("[p2p] --device_telemetry needs --mesh — single-device "
+                  "runs have no replicas to probe; flag ignored")
 
     unet_fn = make_unet_fn(bundle.unet)
     params = bundle.unet_params
@@ -521,6 +562,7 @@ def main(
                     key=k,
                     temporal_maps_dtype=tm_dtype,
                     telemetry=telemetry,
+                    device_probe=device_probe,
                     attn_maps=attn_maps,
                 )
                 traj, edited = res[0], res[1]
@@ -546,6 +588,11 @@ def main(
                         {"summary": summarize_step_stats(tel),
                          "steps": decode_step_stats(tel)},
                     )
+            if device_probe is not None:
+                _ledger_device_stats(
+                    run_ledger, "cached_invert_edit",
+                    jax.device_get(extras.pop(0)), device_probe,
+                )
             if attn_maps:
                 attn_records = jax.device_get(extras.pop(0))
         if run_ledger is not None:
@@ -700,14 +747,20 @@ def main(
                     dependent_sampler=sampler if (dependent_p2p and eta > 0) else None,
                     null_uncond_embeddings=null_embeddings,
                     telemetry=telemetry,
+                    device_probe=device_probe,
                     attn_maps=attn_maps,
                 ),
                 program="edit_sample",
             )(params, x_t, uncond, ek)
-            if telemetry or attn_maps:
+            if telemetry or device_probe is not None or attn_maps:
                 out, *edit_extras = out
                 if telemetry:
                     edit_tel = edit_extras.pop(0)
+                if device_probe is not None:
+                    _ledger_device_stats(
+                        run_ledger, "edit_sample",
+                        jax.device_get(edit_extras.pop(0)), device_probe,
+                    )
                 if attn_maps:
                     attn_records["edit"] = jax.device_get(edit_extras.pop(0))
             out = jax.block_until_ready(out)
@@ -840,4 +893,5 @@ if __name__ == "__main__":
         attn_maps=args.attn_maps,
         quality=args.quality,
         report=args.report,
+        device_telemetry=args.device_telemetry,
     )

@@ -346,6 +346,7 @@ class Transformer3DModel(nn.Module):
     norm_groups: int = 32
     dtype: Dtype = jnp.float32
     gn_impl: str = "auto"
+    group_norm_fn: Optional[Callable] = None
     frame_attention_fn: Optional[Callable] = None
     temporal_attention_fn: Optional[Callable] = None
 
@@ -366,7 +367,7 @@ class Transformer3DModel(nn.Module):
         h = x.reshape(b * f, hh, ww, c)
         h = TpuGroupNorm(
             num_groups=self.norm_groups, epsilon=1e-6, dtype=self.dtype,
-            impl=self.gn_impl, name="norm",
+            impl=self.gn_impl, group_norm_fn=self.group_norm_fn, name="norm",
         )(h)
         h = h.reshape(b, f, hh, ww, c)
         # use_linear_projection=False in SD1.x is a 1×1 conv — identical to a

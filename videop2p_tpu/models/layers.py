@@ -11,7 +11,7 @@ which XLA lowers to one large MXU conv over ``B·F`` images.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -45,9 +45,15 @@ class TpuGroupNorm(nn.Module):
     first (the Transformer3DModel rule, attention.py:361-368).
 
     ``impl``: "auto" (Pallas on TPU when the slab fits, else the XLA
-    two-pass math), "xla" (always two-pass — the sharded-mesh and CPU
-    path; pjit cannot partition a Pallas custom call), "interpret"
+    two-pass math), "xla" (always two-pass — the CPU path), "interpret"
     (kernel in interpret mode — CPU tests only).
+
+    ``group_norm_fn``: the sharded-mesh seam
+    (:func:`videop2p_tpu.parallel.make_sharded_group_norm_fn`). When set
+    it OWNS the kernel decision: it is tried first with the flattened
+    ``(N, rows, C)`` slab, and a ``None`` return (site not covered by the
+    shard_map-wrapped kernel) falls back to the two-pass XLA math — never
+    to the naked Pallas path, which pjit cannot partition.
     """
 
     num_groups: int = 32
@@ -55,6 +61,7 @@ class TpuGroupNorm(nn.Module):
     dtype: Dtype = jnp.float32
     act: str = "none"  # "silu" fuses the activation into the norm
     impl: str = "auto"
+    group_norm_fn: Optional[Callable] = None
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -79,6 +86,17 @@ class TpuGroupNorm(nn.Module):
         for d in x.shape[1:-1]:
             rows *= d
         x2 = x.astype(self.dtype).reshape(n, rows, c)
+        if self.group_norm_fn is not None:
+            y = self.group_norm_fn(
+                x2, scale, bias, num_groups=self.num_groups,
+                eps=self.epsilon, act=self.act,
+            )
+            if y is None:
+                y = group_norm_reference(
+                    x2, scale, bias, num_groups=self.num_groups,
+                    eps=self.epsilon, act=self.act,
+                )
+            return y.reshape(x.shape).astype(self.dtype)
         fits = fits_fused_group_norm(rows, c, x2.dtype)
         use_kernel = self.impl == "interpret" and fits or (
             self.impl == "auto" and fits and jax.default_backend() == "tpu"
@@ -209,6 +227,7 @@ class ResnetBlock3D(nn.Module):
     dropout: float = 0.0
     dtype: Dtype = jnp.float32
     gn_impl: str = "auto"
+    group_norm_fn: Optional[Callable] = None
 
     @nn.compact
     def __call__(
@@ -217,7 +236,8 @@ class ResnetBlock3D(nn.Module):
         in_features = x.shape[-1]
         h = TpuGroupNorm(
             num_groups=self.groups, epsilon=self.eps, dtype=self.dtype,
-            act="silu", impl=self.gn_impl, name="norm1",
+            act="silu", impl=self.gn_impl, group_norm_fn=self.group_norm_fn,
+            name="norm1",
         )(x)
         h = InflatedConv(self.features, dtype=self.dtype, name="conv1")(h)
 
@@ -227,7 +247,8 @@ class ResnetBlock3D(nn.Module):
 
         h = TpuGroupNorm(
             num_groups=self.groups, epsilon=self.eps, dtype=self.dtype,
-            act="silu", impl=self.gn_impl, name="norm2",
+            act="silu", impl=self.gn_impl, group_norm_fn=self.group_norm_fn,
+            name="norm2",
         )(h)
         h = nn.Dropout(self.dropout)(h, deterministic=deterministic)
         h = InflatedConv(self.features, dtype=self.dtype, name="conv2")(h)

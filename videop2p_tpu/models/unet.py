@@ -88,9 +88,10 @@ class UNet3DConfig:
     frame_attention: str = "auto"
     # GroupNorm implementation: "auto" = one-pass fused Pallas kernel on TPU
     # at VMEM-fitting sites (ops/groupnorm.py), "xla" = always the two-pass
-    # XLA math (the sharded-mesh path: pjit cannot partition a Pallas custom
-    # call — parallel/cli setup forces this when a model-internal axis is
-    # sharded), "interpret" = kernel in interpret mode (CPU tests)
+    # XLA math, "interpret" = kernel in interpret mode (CPU tests). Sharded
+    # meshes reach the kernel through the model's group_norm_fn seam
+    # (parallel.make_sharded_group_norm_fn) instead of this knob — pjit
+    # cannot partition a Pallas custom call, shard_map can
     group_norm: str = "auto"
 
     @classmethod
@@ -167,6 +168,11 @@ class UNet3DConditionModel(nn.Module):
     # over a frame-sharded mesh); uncontrolled passes only — controlled sites
     # keep dense probabilities for the P2P edit
     temporal_attention_fn: Optional[Callable] = None
+    # sharded-mesh GroupNorm seam (parallel.make_sharded_group_norm_fn):
+    # carries the fused one-pass kernel onto device meshes via shard_map —
+    # sites it does not cover fall back to the two-pass XLA math, never to
+    # the naked Pallas path pjit cannot partition
+    group_norm_fn: Optional[Callable] = None
 
     @nn.compact
     def __call__(
@@ -216,6 +222,7 @@ class UNet3DConditionModel(nn.Module):
                 add_downsample=not is_final,
                 norm_groups=cfg.norm_num_groups,
                 gn_impl=cfg.group_norm,
+                group_norm_fn=self.group_norm_fn,
                 dtype=self.dtype,
                 frame_attention_fn=frame_attention_fn,
                 temporal_attention_fn=self.temporal_attention_fn,
@@ -242,6 +249,7 @@ class UNet3DConditionModel(nn.Module):
             attn_heads=heads[-1],
             norm_groups=cfg.norm_num_groups,
             gn_impl=cfg.group_norm,
+            group_norm_fn=self.group_norm_fn,
             dtype=self.dtype,
             frame_attention_fn=frame_attention_fn,
             temporal_attention_fn=self.temporal_attention_fn,
@@ -268,6 +276,7 @@ class UNet3DConditionModel(nn.Module):
                 add_upsample=not is_final,
                 norm_groups=cfg.norm_num_groups,
                 gn_impl=cfg.group_norm,
+                group_norm_fn=self.group_norm_fn,
                 dtype=self.dtype,
                 frame_attention_fn=frame_attention_fn,
                 temporal_attention_fn=self.temporal_attention_fn,
@@ -281,7 +290,8 @@ class UNet3DConditionModel(nn.Module):
         # --- out (unet.py:407-409) ---
         x = TpuGroupNorm(
             num_groups=cfg.norm_num_groups, epsilon=1e-5, dtype=self.dtype,
-            act="silu", impl=cfg.group_norm, name="conv_norm_out",
+            act="silu", impl=cfg.group_norm,
+            group_norm_fn=self.group_norm_fn, name="conv_norm_out",
         )(x)
         x = InflatedConv(cfg.out_channels, dtype=self.dtype, name="conv_out")(x)
         return x

@@ -47,6 +47,7 @@ class CrossAttnDownBlock3D(nn.Module):
     add_downsample: bool = True
     norm_groups: int = 32
     gn_impl: str = "auto"
+    group_norm_fn: Optional[Callable] = None
     dtype: Dtype = jnp.float32
     frame_attention_fn: Optional[Callable] = None
     temporal_attention_fn: Optional[Callable] = None
@@ -63,7 +64,8 @@ class CrossAttnDownBlock3D(nn.Module):
         for i in range(self.num_layers):
             x = ResnetBlock3D(
                 self.out_channels, groups=self.norm_groups, dtype=self.dtype,
-                gn_impl=self.gn_impl, name=f"resnets_{i}",
+                gn_impl=self.gn_impl, group_norm_fn=self.group_norm_fn,
+                name=f"resnets_{i}",
             )(x, temb)
             x = Transformer3DModel(
                 heads=self.attn_heads,
@@ -71,6 +73,7 @@ class CrossAttnDownBlock3D(nn.Module):
                 depth=self.transformer_depth,
                 norm_groups=self.norm_groups,
                 gn_impl=self.gn_impl,
+                group_norm_fn=self.group_norm_fn,
                 dtype=self.dtype,
                 frame_attention_fn=self.frame_attention_fn,
                 temporal_attention_fn=self.temporal_attention_fn,
@@ -91,6 +94,7 @@ class DownBlock3D(nn.Module):
     add_downsample: bool = True
     norm_groups: int = 32
     gn_impl: str = "auto"
+    group_norm_fn: Optional[Callable] = None
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -101,7 +105,8 @@ class DownBlock3D(nn.Module):
         for i in range(self.num_layers):
             x = ResnetBlock3D(
                 self.out_channels, groups=self.norm_groups, dtype=self.dtype,
-                gn_impl=self.gn_impl, name=f"resnets_{i}",
+                gn_impl=self.gn_impl, group_norm_fn=self.group_norm_fn,
+                name=f"resnets_{i}",
             )(x, temb)
             outputs.append(x)
         if self.add_downsample:
@@ -119,6 +124,7 @@ class UNetMidBlock3DCrossAttn(nn.Module):
     attn_heads: int = 8
     norm_groups: int = 32
     gn_impl: str = "auto"
+    group_norm_fn: Optional[Callable] = None
     dtype: Dtype = jnp.float32
     frame_attention_fn: Optional[Callable] = None
     temporal_attention_fn: Optional[Callable] = None
@@ -133,7 +139,8 @@ class UNetMidBlock3DCrossAttn(nn.Module):
     ) -> jax.Array:
         x = ResnetBlock3D(
             self.channels, groups=self.norm_groups, dtype=self.dtype,
-            gn_impl=self.gn_impl, name="resnets_0"
+            gn_impl=self.gn_impl, group_norm_fn=self.group_norm_fn,
+            name="resnets_0"
         )(x, temb)
         for i in range(self.num_layers):
             x = Transformer3DModel(
@@ -142,6 +149,7 @@ class UNetMidBlock3DCrossAttn(nn.Module):
                 depth=self.transformer_depth,
                 norm_groups=self.norm_groups,
                 gn_impl=self.gn_impl,
+                group_norm_fn=self.group_norm_fn,
                 dtype=self.dtype,
                 frame_attention_fn=self.frame_attention_fn,
                 temporal_attention_fn=self.temporal_attention_fn,
@@ -149,7 +157,8 @@ class UNetMidBlock3DCrossAttn(nn.Module):
             )(x, context=context, control=control)
             x = ResnetBlock3D(
                 self.channels, groups=self.norm_groups, dtype=self.dtype,
-                gn_impl=self.gn_impl, name=f"resnets_{i + 1}",
+                gn_impl=self.gn_impl, group_norm_fn=self.group_norm_fn,
+                name=f"resnets_{i + 1}",
             )(x, temb)
         return x
 
@@ -165,6 +174,7 @@ class CrossAttnUpBlock3D(nn.Module):
     add_upsample: bool = True
     norm_groups: int = 32
     gn_impl: str = "auto"
+    group_norm_fn: Optional[Callable] = None
     dtype: Dtype = jnp.float32
     frame_attention_fn: Optional[Callable] = None
     temporal_attention_fn: Optional[Callable] = None
@@ -182,7 +192,8 @@ class CrossAttnUpBlock3D(nn.Module):
             x = jnp.concatenate([x, res_samples[-(i + 1)]], axis=-1)
             x = ResnetBlock3D(
                 self.out_channels, groups=self.norm_groups, dtype=self.dtype,
-                gn_impl=self.gn_impl, name=f"resnets_{i}",
+                gn_impl=self.gn_impl, group_norm_fn=self.group_norm_fn,
+                name=f"resnets_{i}",
             )(x, temb)
             x = Transformer3DModel(
                 heads=self.attn_heads,
@@ -190,6 +201,7 @@ class CrossAttnUpBlock3D(nn.Module):
                 depth=self.transformer_depth,
                 norm_groups=self.norm_groups,
                 gn_impl=self.gn_impl,
+                group_norm_fn=self.group_norm_fn,
                 dtype=self.dtype,
                 frame_attention_fn=self.frame_attention_fn,
                 temporal_attention_fn=self.temporal_attention_fn,
@@ -208,6 +220,7 @@ class UpBlock3D(nn.Module):
     add_upsample: bool = True
     norm_groups: int = 32
     gn_impl: str = "auto"
+    group_norm_fn: Optional[Callable] = None
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -221,7 +234,8 @@ class UpBlock3D(nn.Module):
             x = jnp.concatenate([x, res_samples[-(i + 1)]], axis=-1)
             x = ResnetBlock3D(
                 self.out_channels, groups=self.norm_groups, dtype=self.dtype,
-                gn_impl=self.gn_impl, name=f"resnets_{i}",
+                gn_impl=self.gn_impl, group_norm_fn=self.group_norm_fn,
+                name=f"resnets_{i}",
             )(x, temb)
         if self.add_upsample:
             x = Upsample3D(self.out_channels, dtype=self.dtype, name="upsample")(x)

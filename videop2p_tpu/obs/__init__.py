@@ -33,6 +33,12 @@ Pillars (ISSUEs 2–4):
     directories, keys metric series by (program label, HLO fingerprint),
     and evaluates declarative :class:`RegressionRule` thresholds into
     machine-readable verdicts (``tools/obs_diff.py`` is the CLI).
+  * :mod:`videop2p_tpu.obs.comm` — distributed observability (ISSUE 5):
+    collective-communication accounting of sharded programs
+    (``comm_analysis`` events with per-kind counts/bytes + sharding
+    specs), shard_map per-device telemetry probes, and cross-replica
+    divergence measurements gated by ``COMM_RULES`` (divergence must be
+    0.0, zero noise floor).
 
 Everything here is OFF by default: with no active ledger and
 ``telemetry=False`` the fused programs are bit-identical to their
@@ -48,7 +54,18 @@ from videop2p_tpu.obs.attention import (
     site_entropies,
     summarize_attn_record,
 )
+from videop2p_tpu.obs.comm import (
+    COLLECTIVE_KINDS,
+    collective_summary,
+    comm_analysis_record,
+    make_device_probe,
+    replica_divergence,
+    split_device_stats,
+    summarize_device_stats,
+    tree_replica_divergence,
+)
 from videop2p_tpu.obs.history import (
+    COMM_RULES,
     DEFAULT_RULES,
     QUALITY_RULES,
     RegressionRule,
@@ -119,6 +136,15 @@ __all__ = [
     "save_obs_sidecar",
     "load_obs_sidecar",
     "QUALITY_RULES",
+    "COMM_RULES",
+    "COLLECTIVE_KINDS",
+    "collective_summary",
+    "comm_analysis_record",
+    "make_device_probe",
+    "replica_divergence",
+    "tree_replica_divergence",
+    "split_device_stats",
+    "summarize_device_stats",
     "psnr",
     "ssim",
     "masked_psnr",

@@ -38,6 +38,7 @@ __all__ = [
     "RegressionRule",
     "DEFAULT_RULES",
     "QUALITY_RULES",
+    "COMM_RULES",
     "split_runs",
     "extract_run",
     "evaluate_rules",
@@ -55,14 +56,20 @@ class RegressionRule:
     (program_analysis metrics), ``"compile"`` (per-program compile
     seconds), ``"phase"`` (phase wall-clock), ``"dispatch"`` (program_call
     dispatch seconds), ``"quality"`` (edit-quality metrics from the
-    ``quality`` ledger event — PSNR/SSIM). ``min_abs`` suppresses verdicts
+    ``quality`` ledger event — PSNR/SSIM), ``"comm"`` (collective
+    counts/bytes from ``comm_analysis`` events), ``"device_memory"``
+    (per-device peak HBM from ``memory`` snapshots), ``"divergence"``
+    (cross-replica divergence scalars). ``min_abs`` suppresses verdicts
     whose absolute delta is noise-sized (a 0.001 s phase doubling is not a
     regression). ``programs`` (labels for program/compile/dispatch kinds,
     phase names for phases) restricts the rule; None applies it everywhere.
 
     ``direction``: ``"increase"`` (the default — flops/bytes/seconds
-    regress by GROWING) or ``"decrease"`` for metrics that regress by
-    DROPPING (reconstruction / background-preservation PSNR, SSIM).
+    regress by GROWING), ``"decrease"`` for metrics that regress by
+    DROPPING (reconstruction / background-preservation PSNR, SSIM), or
+    ``"nonzero"`` for invariants that must be EXACTLY zero with no noise
+    floor (replica divergence) — any nonzero new value regresses, even
+    against an identical baseline.
     """
 
     metric: str
@@ -74,6 +81,8 @@ class RegressionRule:
 
     @property
     def name(self) -> str:
+        if self.direction == "nonzero":
+            return f"{self.kind}:{self.metric}!=0"
         sign = "-" if self.direction == "decrease" else "+"
         return f"{self.kind}:{self.metric}{sign}{self.threshold_pct:g}%"
 
@@ -92,6 +101,19 @@ QUALITY_RULES: Tuple[RegressionRule, ...] = (
                    threshold_pct=2.0, min_abs=0.005),
 )
 
+# distributed gates (ISSUE 5): collective traffic growing means XLA is
+# moving more bytes between chips for the same program; per-device peak
+# HBM guards each chip's residency; replica divergence is an exactness
+# invariant — it must be 0.0, with NO noise floor (a single diverged
+# replica silently corrupts every edit it touches).
+COMM_RULES: Tuple[RegressionRule, ...] = (
+    RegressionRule("collective_bytes", kind="comm", threshold_pct=15.0),
+    RegressionRule("collective_count", kind="comm", threshold_pct=25.0),
+    RegressionRule("peak_bytes_in_use", kind="device_memory",
+                   threshold_pct=10.0, min_abs=1 << 20),
+    RegressionRule("value", kind="divergence", direction="nonzero"),
+)
+
 DEFAULT_RULES: Tuple[RegressionRule, ...] = (
     RegressionRule("flops", threshold_pct=10.0),
     RegressionRule("bytes_accessed", threshold_pct=15.0, min_abs=1 << 20),
@@ -100,7 +122,7 @@ DEFAULT_RULES: Tuple[RegressionRule, ...] = (
     RegressionRule("hlo_instructions", threshold_pct=25.0, min_abs=16),
     RegressionRule("seconds", kind="compile", threshold_pct=50.0, min_abs=1.0),
     RegressionRule("seconds", kind="phase", threshold_pct=25.0, min_abs=0.5),
-) + QUALITY_RULES
+) + QUALITY_RULES + COMM_RULES
 
 
 def split_runs(events: Iterable[Dict[str, Any]]) -> List[List[Dict[str, Any]]]:
@@ -140,6 +162,11 @@ def extract_run(events: Sequence[Dict[str, Any]],
         "phases": {},
         "dispatch": {},
         "quality": {},
+        # distributed sections (ISSUE 5) — empty for pre-PR-5 ledgers,
+        # which every consumer tolerates (no shared labels → no verdicts)
+        "comm": {},
+        "device_memory": {},
+        "divergence": {},
     }
     for e in events:
         kind = e.get("event")
@@ -181,6 +208,48 @@ def extract_run(events: Sequence[Dict[str, Any]],
                     continue
                 if isinstance(v, (int, float)) and not isinstance(v, bool):
                     rec["quality"][k] = float(v)
+        elif kind == "comm_analysis":
+            label = e.get("program") or "(unattributed)"
+            rec["comm"][label] = {
+                k: v for k, v in e.items()
+                if k not in ("event", "t", "program")
+                and isinstance(v, (int, float)) and not isinstance(v, bool)
+            }
+        elif kind == "memory":
+            # per-device peak residency: keep the worst snapshot per device
+            for d in e.get("devices") or ():
+                if not isinstance(d, dict):
+                    continue
+                peak = d.get("peak_bytes_in_use")
+                if peak is None:
+                    continue
+                label = f"device{d.get('device')}"
+                try:
+                    peak = float(peak)
+                except (TypeError, ValueError):
+                    continue
+                rec["device_memory"][label] = max(
+                    rec["device_memory"].get(label, 0.0), peak
+                )
+        elif kind == "divergence":
+            label = e.get("label") or "(unattributed)"
+            try:
+                val = float(e.get("value", 0.0))
+            except (TypeError, ValueError):
+                continue
+            rec["divergence"][label] = max(
+                rec["divergence"].get(label, 0.0), val
+            )
+        elif kind == "device_telemetry":
+            # the in-scan probe's worst divergence joins the same gate
+            label = e.get("program") or "(unattributed)"
+            try:
+                val = float(e.get("divergence_max", 0.0))
+            except (TypeError, ValueError):
+                continue
+            rec["divergence"][label] = max(
+                rec["divergence"].get(label, 0.0), val
+            )
     return rec
 
 
@@ -203,6 +272,16 @@ def _rule_values(record: Dict[str, Any], rule: RegressionRule) -> Dict[str, floa
         q = record.get("quality", {})
         if rule.metric in q:
             out["edit_quality"] = float(q[rule.metric])
+    elif rule.kind == "comm":
+        for label, m in record.get("comm", {}).items():
+            if rule.metric in m:
+                out[label] = float(m[rule.metric])
+    elif rule.kind == "device_memory":
+        if rule.metric == "peak_bytes_in_use":
+            out = {k: float(v)
+                   for k, v in record.get("device_memory", {}).items()}
+    elif rule.kind == "divergence":
+        out = {k: float(v) for k, v in record.get("divergence", {}).items()}
     if rule.programs is not None:
         out = {k: v for k, v in out.items() if k in rule.programs}
     return out
@@ -232,6 +311,22 @@ def evaluate_rules(
         for label in sorted(set(bvals) & set(nvals)):
             b, n = bvals[label], nvals[label]
             delta = n - b
+            if rule.direction == "nonzero":
+                # an exactness invariant: any nonzero (or NaN) new value
+                # regresses, baseline regardless — self-comparison of a
+                # diverged run must still fail
+                regressed = not (n == 0.0)
+                verdicts.append({
+                    "rule": rule.name,
+                    "kind": rule.kind,
+                    "program": label,
+                    "metric": rule.metric,
+                    "base": b,
+                    "new": n,
+                    "delta_pct": 0.0 if not regressed else None,
+                    "regressed": regressed,
+                })
+                continue
             if rule.direction == "decrease":
                 # quality metrics regress by DROPPING; inf baselines (an
                 # exact reconstruction) pass only against inf, and losing

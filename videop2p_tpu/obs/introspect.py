@@ -38,6 +38,7 @@ import jax
 __all__ = [
     "analyze_compiled",
     "analyze_jitted",
+    "compile_abstract",
     "hlo_fingerprint",
     "instruction_histogram",
     "abstractify_args",
@@ -152,10 +153,26 @@ def analyze_compiled(compiled) -> Dict[str, Any]:
 
 def abstractify_args(args, kwargs):
     """Array leaves → ShapeDtypeStructs (so a later ``.lower()`` never
-    touches possibly-donated/deleted buffers); everything else unchanged."""
+    touches possibly-donated/deleted buffers); everything else unchanged.
+
+    Multi-device leaves keep their sharding on the ShapeDtypeStruct, so
+    re-lowering builds the SAME partitioned SPMD program the call executed
+    — the property that makes the sharded ``program_analysis`` and
+    ``comm_analysis`` events honest. Single-device leaves stay
+    sharding-free (attaching a SingleDeviceSharding would churn the HLO
+    fingerprints every PR-3 baseline already pinned)."""
 
     def to_abstract(leaf):
         if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            sharding = getattr(leaf, "sharding", None)
+            try:
+                multi = sharding is not None and len(sharding.device_set) > 1
+            except Exception:  # noqa: BLE001
+                multi = False
+            if multi:
+                return jax.ShapeDtypeStruct(
+                    leaf.shape, leaf.dtype, sharding=sharding
+                )
             return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype)
         return leaf
 
@@ -163,9 +180,9 @@ def abstractify_args(args, kwargs):
             jax.tree.map(to_abstract, kwargs))
 
 
-def analyze_jitted(jitted, *args, **kwargs) -> Optional[Dict[str, Any]]:
+def compile_abstract(jitted, *args, **kwargs):
     """Lower + compile ``jitted`` at the given (possibly abstract) arguments
-    and return :func:`analyze_compiled`'s record, or None on any failure.
+    and return the ``jax.stages.Compiled`` executable, or None on failure.
 
     This is the ahead-of-time path (``jit(f).lower(...).compile()``) — the
     executable is built but NEVER executed, which is what makes the whole
@@ -174,6 +191,13 @@ def analyze_jitted(jitted, *args, **kwargs) -> Optional[Dict[str, Any]]:
     compile behind an already-executed program is a cache hit.
     """
     try:
-        return analyze_compiled(jitted.lower(*args, **kwargs).compile())
+        return jitted.lower(*args, **kwargs).compile()
     except Exception:  # noqa: BLE001
         return None
+
+
+def analyze_jitted(jitted, *args, **kwargs) -> Optional[Dict[str, Any]]:
+    """:func:`compile_abstract` + :func:`analyze_compiled`, or None on any
+    failure."""
+    compiled = compile_abstract(jitted, *args, **kwargs)
+    return analyze_compiled(compiled) if compiled is not None else None

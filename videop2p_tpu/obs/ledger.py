@@ -221,6 +221,17 @@ class RunLedger:
 
     def phase(self, name: str, seconds: float, **fields: Any) -> None:
         self.event("phase", name=name, seconds=round(float(seconds), 4), **fields)
+        # multi-host runs: additionally tag the measurement with the process
+        # identity (host_phase events) so merged ledgers expose per-host
+        # straggler skew (parallel/distributed.phase_skew). Single-host runs
+        # skip it — the skew is trivially 0 and the events would only bloat.
+        try:
+            if jax.process_count() > 1:
+                from videop2p_tpu.parallel.distributed import host_phase_record
+
+                self.event("host_phase", **host_phase_record(name, seconds))
+        except Exception:  # noqa: BLE001 — observability never breaks timing
+            pass
 
     def telemetry(self, program: str, record: Dict[str, Any]) -> None:
         self.event("telemetry", program=program, **record)
@@ -230,39 +241,76 @@ class RunLedger:
         (obs.introspect.analyze_compiled/analyze_jitted) for ``program``."""
         self.event("program_analysis", program=program, **record)
 
+    def comm_analysis(self, program: str, record: Dict[str, Any]) -> None:
+        """Record one collective-communication accounting record
+        (obs.comm.comm_analysis_record) for a sharded ``program``."""
+        self.event("comm_analysis", program=program, **record)
+
+    def device_telemetry(self, program: str, record: Dict[str, Any]) -> None:
+        """Record a decoded per-device telemetry summary
+        (obs.comm.summarize_device_stats) for ``program``."""
+        self.event("device_telemetry", program=program, **record)
+
+    def divergence(self, label: str, value: float, **fields: Any) -> None:
+        """Record one cross-replica divergence measurement
+        (obs.comm.replica_divergence) — must be 0.0; the COMM_RULES
+        verdict has a zero noise floor."""
+        self.event("divergence", label=label, value=float(value), **fields)
+
     def _on_compile(self, seconds: float, program: Optional[str]) -> None:
         self.compile_seconds.append(float(seconds))
         self.event("compile", seconds=round(float(seconds), 4),
                    program=program, metric="backend_compile")
 
     def memory_snapshot(self, note: Optional[str] = None) -> None:
-        """Per-device memory_stats + live-buffer census, where the backend
-        supports them (CPU reports supported: false rather than nothing —
-        the schema stays stable across backends)."""
+        """Per-device memory_stats + live-buffer census.
+
+        Every local device gets an entry keyed by id/coords/process (TPU
+        coords; None on CPU) so sharded runs see per-chip residency, not
+        just a process total. Where the backend has no ``memory_stats``
+        (CPU) the stats fields are None, ``supported`` is false, and the
+        per-device ``live_bytes`` census (summed over each array's
+        addressable shards) still distinguishes the devices — the schema
+        stays stable across backends."""
+        per_dev_live: Dict[int, int] = {}
+        live = None
+        try:
+            arrs = jax.live_arrays()
+            live = {"count": len(arrs),
+                    "bytes": int(sum(a.nbytes for a in arrs))}
+            for a in arrs:
+                try:
+                    for sh in a.addressable_shards:
+                        did = sh.device.id
+                        per_dev_live[did] = (
+                            per_dev_live.get(did, 0) + int(sh.data.nbytes)
+                        )
+                except Exception:  # noqa: BLE001
+                    continue
+        except Exception:  # noqa: BLE001
+            pass
         devices = []
+        supported = False
         try:
             for d in jax.local_devices():
                 try:
                     ms = d.memory_stats()
                 except Exception:  # noqa: BLE001
                     ms = None
-                if ms:
-                    devices.append({
-                        "device": d.id,
-                        "bytes_in_use": ms.get("bytes_in_use"),
-                        "peak_bytes_in_use": ms.get("peak_bytes_in_use"),
-                        "bytes_limit": ms.get("bytes_limit"),
-                    })
+                supported = supported or bool(ms)
+                coords = getattr(d, "coords", None)
+                devices.append({
+                    "device": d.id,
+                    "coords": list(coords) if coords is not None else None,
+                    "process_index": getattr(d, "process_index", None),
+                    "bytes_in_use": (ms or {}).get("bytes_in_use"),
+                    "peak_bytes_in_use": (ms or {}).get("peak_bytes_in_use"),
+                    "bytes_limit": (ms or {}).get("bytes_limit"),
+                    "live_bytes": per_dev_live.get(d.id),
+                })
         except Exception:  # noqa: BLE001
             pass
-        live = None
-        try:
-            arrs = jax.live_arrays()
-            live = {"count": len(arrs),
-                    "bytes": int(sum(a.nbytes for a in arrs))}
-        except Exception:  # noqa: BLE001
-            pass
-        self.event("memory", note=note, supported=bool(devices),
+        self.event("memory", note=note, supported=supported,
                    devices=devices, live_arrays=live)
 
     # ---- lifecycle -------------------------------------------------------
@@ -308,38 +356,40 @@ class RunLedger:
 
 def _analyze_into_ledger(led: "RunLedger", jitted, program: str,
                          abstract_args, abstract_kwargs) -> None:
-    """Mine the program XLA just built (cost/memory analysis, HLO
-    fingerprint, instruction histogram) into a ``program_analysis`` event.
+    """Mine the program XLA just built into ``program_analysis`` (cost/
+    memory analysis, HLO fingerprint, instruction histogram) and — for
+    sharded programs — ``comm_analysis`` (collective counts/bytes and
+    sharding specs, obs/comm.py) events.
 
     Runs the AOT ``lower(...).compile()`` path on ABSTRACT arguments — the
-    executed call may have donated its buffers — with compile-event
-    recording suppressed (the recompile is a persistent-cache hit wherever
-    a cache is configured; either way it is not the run's own compile
-    work). Best-effort: any failure leaves the ledger without the event,
-    never breaks the call that triggered it.
+    executed call may have donated its buffers; sharded leaves keep their
+    shardings so the re-lowered module IS the partitioned SPMD program —
+    with compile-event recording suppressed (the recompile is a
+    persistent-cache hit wherever a cache is configured; either way it is
+    not the run's own compile work). A failed lower/compile emits a
+    ``program_analysis_skipped`` event with the reason instead of dropping
+    the record on the floor; nothing here ever breaks the call that
+    triggered it.
     """
-    from videop2p_tpu.obs import introspect
+    from videop2p_tpu.obs import comm, introspect
 
     with suppress_compile_events():
-        rec = introspect.analyze_jitted(
+        compiled = introspect.compile_abstract(
             jitted, *abstract_args, **abstract_kwargs
         )
+    if compiled is None:
+        led.event("program_analysis_skipped", program=program,
+                  reason="lower_or_compile_failed")
+        return
+    rec = introspect.analyze_compiled(compiled)
     if rec:
         led.program_analysis(program, rec)
-
-
-def _multi_device(tree) -> bool:
-    """True when any array leaf is sharded across >1 device — abstract
-    re-lowering would then build a DIFFERENT (unsharded) program, so the
-    automatic analysis skips rather than mis-report."""
-    for leaf in jax.tree.leaves(tree):
-        sharding = getattr(leaf, "sharding", None)
-        try:
-            if sharding is not None and len(sharding.device_set) > 1:
-                return True
-        except Exception:  # noqa: BLE001
-            continue
-    return False
+    comm_rec = comm.comm_analysis_record(compiled)
+    if comm_rec is not None and (
+        comm_rec.get("num_partitions", 1) > 1
+        or comm_rec.get("collective_count", 0)
+    ):
+        led.comm_analysis(program, comm_rec)
 
 
 def instrumented_jit(fun, *, program: str, analyze: bool = True, **jit_kwargs):
@@ -353,10 +403,16 @@ def instrumented_jit(fun, *, program: str, analyze: bool = True, **jit_kwargs):
     ``program_analysis`` event — XLA's cost/memory analysis, a stable
     optimized-HLO fingerprint, and an instruction histogram
     (obs/introspect.py) — which is what ``obs/history.py`` and
-    ``tools/obs_diff.py`` diff across runs. Disable process-wide with
-    ``VIDEOP2P_OBS_NO_ANALYSIS=1`` (the CLIs' ``--no_program_analysis``).
-    With no active ledger the wrapper adds one attribute lookup and
-    nothing else — the jitted callable is returned straight through.
+    ``tools/obs_diff.py`` diff across runs. Sharded calls re-lower with
+    their shardings preserved, so the analysis describes the partitioned
+    SPMD program and additionally emits a ``comm_analysis`` event with
+    per-kind collective counts/bytes (obs/comm.py). When the analysis is
+    disabled or cannot run, a ``program_analysis_skipped`` event records
+    the reason — a missing record is a statement, never silence. Disable
+    process-wide with ``VIDEOP2P_OBS_NO_ANALYSIS=1`` (the CLIs'
+    ``--no_program_analysis``). With no active ledger the wrapper adds one
+    attribute lookup and nothing else — the jitted callable is returned
+    straight through.
     """
     jitted = jax.jit(fun, **jit_kwargs)
 
@@ -368,15 +424,21 @@ def instrumented_jit(fun, *, program: str, analyze: bool = True, **jit_kwargs):
             before = jitted._cache_size()
         except Exception:  # noqa: BLE001 — private API; degrade gracefully
             before = None
-        want_analysis = analyze and before is not None and analysis_enabled()
-        if want_analysis:
+        skip_reason = None
+        if not analyze:
+            skip_reason = "analyze_false"
+        elif not analysis_enabled():
+            skip_reason = "disabled"
+        elif before is None:
+            skip_reason = "cache_introspection_unavailable"
+        if skip_reason is None:
             # abstractify BEFORE the call: donated buffers are deleted by it
             from videop2p_tpu.obs.introspect import abstractify_args
 
             try:
                 abs_args, abs_kwargs = abstractify_args(args, kwargs)
             except Exception:  # noqa: BLE001
-                want_analysis = False
+                skip_reason = "abstractify_failed"
         t0 = time.perf_counter()
         with program_label(program):
             out = jitted(*args, **kwargs)
@@ -389,11 +451,18 @@ def instrumented_jit(fun, *, program: str, analyze: bool = True, **jit_kwargs):
                 miss = None
         led.event("program_call", program=program, cache_miss=miss,
                   dispatch_s=round(dt, 4))
-        if miss and want_analysis and not _multi_device((args, kwargs)):
-            try:
-                _analyze_into_ledger(led, jitted, program, abs_args, abs_kwargs)
-            except Exception:  # noqa: BLE001 — observability never kills a run
-                pass
+        if miss:
+            if skip_reason is None:
+                try:
+                    _analyze_into_ledger(
+                        led, jitted, program, abs_args, abs_kwargs
+                    )
+                except Exception:  # noqa: BLE001 — obs never kills a run
+                    led.event("program_analysis_skipped", program=program,
+                              reason="analysis_error")
+            else:
+                led.event("program_analysis_skipped", program=program,
+                          reason=skip_reason)
         return out
 
     wrapper._jitted = jitted  # escape hatch (lower/compile introspection)

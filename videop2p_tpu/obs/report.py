@@ -12,7 +12,11 @@ the repo's equivalent of Prompt-to-Prompt's ``show_cross_attention``
   * the null-text optimization loss sparkline (full mode);
   * the edit-quality table (``obs/quality.py`` PSNR/SSIM metrics);
   * the PR-3 regression verdicts (``obs/history.py`` rules), quality
-    rules included.
+    rules included;
+  * a communication section for sharded runs (``obs/comm.py`` events):
+    per-program collective counts/bytes, per-device telemetry with the
+    cross-replica divergence verdict (must be 0.0), and per-host phase
+    skew when host_phase events exist.
 
 ``tools/edit_report.py`` is the CLI wrapper. The ledger is parsed with a
 local JSONL reader (not ``obs.ledger``) so this module's import closure
@@ -332,6 +336,81 @@ def _verdict_section(events) -> str:
                                             "with the baseline)</p>"))
 
 
+def _comm_section(events) -> str:
+    """Distributed observability (obs/comm.py events): collective
+    accounting, per-device telemetry + divergence, host skew. Empty for
+    single-device / pre-distributed-obs ledgers."""
+    out: List[str] = []
+
+    comm_evs = [e for e in events if e.get("event") == "comm_analysis"]
+    if comm_evs:
+        rows = []
+        for e in comm_evs:
+            per_kind = e.get("per_kind") or {}
+            kinds = ", ".join(
+                f"{k}×{v.get('count')}" for k, v in sorted(per_kind.items())
+                if isinstance(v, dict)
+            )
+            rows.append([e.get("program", "?"), e.get("num_partitions"),
+                         e.get("collective_count"),
+                         e.get("collective_bytes"), kinds or "-"])
+        out.append(
+            "<h3>Collective communication</h3>"
+            "<p class=meta>static per-module collective counts and "
+            "result-shape bytes of the partitioned programs "
+            "(comm_analysis events).</p>"
+            + _table(rows, ["program", "partitions", "collectives",
+                            "bytes", "per-kind"]))
+
+    dev_rows, dev_classes = [], []
+    for e in events:
+        if e.get("event") == "device_telemetry":
+            div = e.get("divergence_max")
+            bad = isinstance(div, (int, float)) and div != 0.0
+            dev_rows.append([e.get("program", "?"), e.get("devices"),
+                             div, e.get("nan_total", 0),
+                             "DIVERGED" if bad else "ok"])
+            dev_classes.append("bad" if bad else "")
+        elif e.get("event") == "divergence":
+            val = e.get("value")
+            bad = isinstance(val, (int, float)) and val != 0.0
+            dev_rows.append([e.get("label", "?"), "-", val, "-",
+                             "DIVERGED" if bad else "ok"])
+            dev_classes.append("bad" if bad else "")
+    if dev_rows:
+        out.append(
+            "<h3>Per-device telemetry &amp; replica divergence</h3>"
+            "<p class=meta>cross-replica divergence is an exactness "
+            "invariant — it must be 0.0 (zero noise floor, COMM_RULES).</p>"
+            + _table(dev_rows, ["program/label", "devices", "divergence",
+                                "NaN", "verdict"], dev_classes))
+
+    host: Dict[str, Dict[int, float]] = {}
+    for e in events:
+        if e.get("event") != "host_phase" or e.get("name") is None:
+            continue
+        try:
+            hosts = host.setdefault(str(e["name"]), {})
+            proc = int(e.get("process_index", 0))
+            hosts[proc] = hosts.get(proc, 0.0) + float(e.get("seconds", 0.0))
+        except (TypeError, ValueError):
+            continue
+    if host:
+        rows = []
+        for name, hosts in sorted(host.items()):
+            vals = list(hosts.values())
+            rows.append([name, len(hosts), f"{min(vals):.2f}",
+                         f"{max(vals):.2f}", f"{max(vals) - min(vals):.2f}",
+                         max(hosts, key=hosts.get)])
+        out.append("<h3>Per-host phase skew</h3>"
+                   + _table(rows, ["phase", "hosts", "min s", "max s",
+                                   "skew s", "slowest proc"]))
+
+    if not out:
+        return ""
+    return "<h2>Distributed / communication</h2>" + "".join(out)
+
+
 def _phase_trace_section(events) -> str:
     phases: Dict[str, float] = {}
     for e in events:
@@ -379,6 +458,7 @@ def render_report(events: Sequence[Dict[str, Any]],
         _word_heat_section(events, sidecar),
         _mask_section(events, sidecar),
         _null_text_section(events),
+        _comm_section(events),
         _verdict_section(events),
         _phase_trace_section(events),
         '<p class=meta>generated by tools/edit_report.py — stdlib+numpy, '

@@ -7,14 +7,18 @@ from videop2p_tpu.parallel.mesh import (
     latent_sharding,
     make_mesh,
     make_sharded_frame_attention_fn,
+    make_sharded_group_norm_fn,
     param_shardings,
     replicated,
     shard_array,
     text_sharding,
 )
 from videop2p_tpu.parallel.distributed import (
+    emit_host_phase,
+    host_phase_record,
     initialize_distributed,
     make_hybrid_mesh,
+    phase_skew,
 )
 from videop2p_tpu.parallel.ring import (
     make_ring_temporal_fn,
@@ -29,12 +33,16 @@ __all__ = [
     "latent_sharding",
     "make_mesh",
     "make_sharded_frame_attention_fn",
+    "make_sharded_group_norm_fn",
     "param_shardings",
     "replicated",
     "shard_array",
     "text_sharding",
     "initialize_distributed",
     "make_hybrid_mesh",
+    "host_phase_record",
+    "emit_host_phase",
+    "phase_skew",
     "make_ring_temporal_fn",
     "ring_attention",
     "ring_attention_sharded",

@@ -20,7 +20,8 @@ is a no-op for them.
 from __future__ import annotations
 
 import os
-from typing import Optional, Tuple
+import socket
+from typing import Any, Dict, Iterable, Optional, Tuple
 
 import jax
 import numpy as np
@@ -28,7 +29,13 @@ from jax.sharding import Mesh
 
 from videop2p_tpu.parallel.mesh import AXIS_DATA, AXIS_FRAMES, AXIS_TENSOR
 
-__all__ = ["initialize_distributed", "make_hybrid_mesh"]
+__all__ = [
+    "initialize_distributed",
+    "make_hybrid_mesh",
+    "host_phase_record",
+    "emit_host_phase",
+    "phase_skew",
+]
 
 
 def initialize_distributed(
@@ -107,3 +114,71 @@ def make_hybrid_mesh(
         )
         return Mesh(dev_array, axis_names)
     return Mesh(np.asarray(devices).reshape(dp, sp, tp), axis_names)
+
+
+# ------------------------------------------------- per-host phase timing --
+#
+# A multi-host step is as slow as its slowest host, and a straggler is
+# invisible in a single host's `phase` events: every host measures the same
+# phase name, but the ledgers never meet. `host_phase` events carry the
+# process identity with each measurement so merged ledgers (one file per
+# host, or one shared filesystem path appended by all) expose the skew —
+# the max−min spread per phase name — which is the straggler signal
+# tools/ledger_summary.py renders.
+
+
+def host_phase_record(name: str, seconds: float) -> Dict[str, Any]:
+    """One host's wall-clock for a named phase, tagged with its process
+    identity. Single-host runs record process 0 of 1 — the schema is the
+    same, the skew is trivially 0."""
+    return {
+        "name": name,
+        "seconds": round(float(seconds), 4),
+        "process_index": int(jax.process_index()),
+        "process_count": int(jax.process_count()),
+        "hostname": socket.gethostname(),
+    }
+
+
+def emit_host_phase(name: str, seconds: float, ledger=None) -> None:
+    """Append a ``host_phase`` event to ``ledger`` (default: the active
+    RunLedger; a no-op without one — same contract as phase_timer)."""
+    if ledger is None:
+        from videop2p_tpu.obs.ledger import current_ledger
+
+        ledger = current_ledger()
+    if ledger is not None:
+        ledger.event("host_phase", **host_phase_record(name, seconds))
+
+
+def phase_skew(events: Iterable[Dict[str, Any]]) -> Dict[str, Dict[str, Any]]:
+    """Per-phase straggler summary over ``host_phase`` events: for each
+    phase name seen from ≥1 host, the fastest/slowest host seconds, the
+    skew (max − min), and the slowest process index. Hosts that measured a
+    phase more than once contribute their summed seconds (matching the
+    per-host ``phase`` accumulation in obs/history.py)."""
+    per_phase: Dict[str, Dict[int, float]] = {}
+    for e in events:
+        if not isinstance(e, dict) or e.get("event", "host_phase") != "host_phase":
+            continue
+        name = e.get("name")
+        if name is None:
+            continue
+        try:
+            seconds = float(e.get("seconds", 0.0))
+            proc = int(e.get("process_index", 0))
+        except (TypeError, ValueError):
+            continue
+        hosts = per_phase.setdefault(str(name), {})
+        hosts[proc] = hosts.get(proc, 0.0) + seconds
+    out: Dict[str, Dict[str, Any]] = {}
+    for name, hosts in per_phase.items():
+        slowest = max(hosts, key=hosts.get)
+        out[name] = {
+            "hosts": len(hosts),
+            "min_s": round(min(hosts.values()), 4),
+            "max_s": round(max(hosts.values()), 4),
+            "skew_s": round(max(hosts.values()) - min(hosts.values()), 4),
+            "slowest_process": slowest,
+        }
+    return out

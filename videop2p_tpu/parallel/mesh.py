@@ -37,6 +37,7 @@ __all__ = [
     "replicated",
     "param_shardings",
     "make_sharded_frame_attention_fn",
+    "make_sharded_group_norm_fn",
     "shard_array",
 ]
 
@@ -127,6 +128,60 @@ def make_sharded_frame_attention_fn(mesh: Mesh, impl: str = "auto"):
             inner, mesh=mesh, in_specs=(qspec, kvspec, kvspec),
             out_specs=qspec,
         )(q, k, v)
+
+    return fn
+
+
+def make_sharded_group_norm_fn(mesh: Mesh, impl: str = "auto"):
+    """Fused one-pass GroupNorm (ops/groupnorm.py) for sharded meshes, via
+    the same shard_map wrapper pattern as
+    :func:`make_sharded_frame_attention_fn`: pjit/GSPMD cannot partition a
+    Pallas custom call, but GroupNorm statistics are strictly per-sample
+    (dim 0 of the ``(N, rows, C)`` slab), so splitting the sample axis over
+    ``data × frames`` keeps every statistics sample whole on one chip and
+    the single-chip kernel runs on its local slab unchanged.
+
+    Returns ``fn(x2, scale, bias, *, num_groups, eps, act) -> y | None``
+    for the :class:`~videop2p_tpu.models.layers.TpuGroupNorm`
+    ``group_norm_fn`` seam. ``None`` means "site not covered" — slab over
+    the VMEM gate, sample axis not divisible by the ``dp·sp`` shard count
+    (the frame-POOLED resnet slabs, whose statistics cross frame shards),
+    or no kernel on this backend — and the caller falls back to the
+    two-pass XLA math, which GSPMD partitions exactly as before. The
+    covered sites are the frames-folded per-frame GNs (the
+    Transformer3DModel entry norms), whose slabs are local on every shard.
+
+    ``impl``: "auto" (kernel on TPU), "interpret" (Pallas interpret mode —
+    the CPU-mesh tests), anything else disables the kernel.
+    """
+    from videop2p_tpu.ops.groupnorm import fits_fused_group_norm, fused_group_norm
+
+    shards = mesh.shape[AXIS_DATA] * mesh.shape[AXIS_FRAMES]
+
+    def fn(x2: jax.Array, scale: jax.Array, bias: jax.Array, *,
+           num_groups: int, eps: float, act: str):
+        interpret = impl == "interpret"
+        if not interpret and not (
+            impl == "auto" and jax.default_backend() == "tpu"
+        ):
+            return None
+        n, rows, c = x2.shape
+        if n % shards != 0 or not fits_fused_group_norm(rows, c, x2.dtype):
+            return None
+        import functools
+
+        from videop2p_tpu.parallel.ring import shard_map_compat
+
+        inner = functools.partial(
+            fused_group_norm, num_groups=num_groups, eps=eps, act=act,
+            interpret=interpret,
+        )
+        sample_spec = P((AXIS_DATA, AXIS_FRAMES), None, None)
+        return shard_map_compat(
+            inner, mesh=mesh,
+            in_specs=(sample_spec, P(None), P(None)),
+            out_specs=sample_spec,
+        )(x2, scale, bias)
 
     return fn
 

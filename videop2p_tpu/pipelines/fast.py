@@ -10,7 +10,7 @@ cannot drift apart.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Callable, Optional, Tuple
 
 import jax
 
@@ -132,6 +132,7 @@ def cached_fast_edit(
     key: Optional[jax.Array] = None,
     temporal_maps_dtype=None,
     telemetry: bool = False,
+    device_probe: Optional[Callable] = None,
     attn_maps: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """Capture-inversion of ``latents`` under ``cond_src`` followed by the
@@ -139,12 +140,14 @@ def cached_fast_edit(
     ``(trajectory, edited_latents)`` — the trajectory for persistence, the
     (P, F, h, w, C) output with stream 0 the exact reconstruction.
     ``telemetry=True`` adds the edit scan's per-step telemetry
-    (sampling.edit_sample) riding the same fused program; ``attn_maps=True``
+    (sampling.edit_sample) riding the same fused program; ``device_probe``
+    (obs.comm.make_device_probe) adds per-device stats + cross-replica
+    divergence of the edit scan's latents the same way; ``attn_maps=True``
     adds the attention observability capture (obs.attention) as
     ``{"inversion": ..., "edit": ...}`` — the source stream's heatmaps from
     the inversion walk plus the edit streams' heatmaps / entropies / blend
-    mask series. Return order ``(trajectory, edited[, tel][, attn])``; both
-    off by default, leaving the program byte-identical."""
+    mask series. Return order ``(trajectory, edited[, tel][, dev][, attn])``;
+    all off by default, leaving the program byte-identical."""
     inv = ddim_inversion_captured(
         unet_fn, params, scheduler, latents, cond_src,
         num_inference_steps=num_inference_steps,
@@ -166,13 +169,16 @@ def cached_fast_edit(
         source_uses_cfg=False,
         cached_source=cached,
         telemetry=telemetry,
+        device_probe=device_probe,
         attn_maps=attn_maps,
     )
-    if not (telemetry or attn_maps):
+    if not (telemetry or device_probe is not None or attn_maps):
         return trajectory, edited
     edited, *extras = edited
     out = (trajectory, edited)
     if telemetry:
+        out += (extras.pop(0),)
+    if device_probe is not None:
         out += (extras.pop(0),)
     if attn_maps:
         out += ({"inversion": inv[2], "edit": extras.pop(0)},)

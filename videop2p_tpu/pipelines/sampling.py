@@ -80,12 +80,14 @@ def _mask_series_entry(maps_sum, blend_cfg, step_index, latent_hw):
     }
 
 
-def _pack_step_outputs(telemetry, tel, attn_maps, attn):
-    """Scan ``ys`` for the optional observability channels (None when both
+def _pack_step_outputs(telemetry, tel, attn_maps, attn, dev=None):
+    """Scan ``ys`` for the optional observability channels (None when all
     are off, so the off-path scan is the exact pre-observability scan)."""
     ys = {}
     if telemetry:
         ys["tel"] = tel
+    if dev is not None:
+        ys["dev"] = dev
     if attn_maps:
         ys["attn"] = attn
     return ys or None
@@ -128,6 +130,7 @@ def edit_sample(
     null_uncond_embeddings: Optional[jax.Array] = None,
     cached_source: Optional[CachedSource] = None,
     telemetry: bool = False,
+    device_probe: Optional[Callable] = None,
     attn_maps: bool = False,
 ) -> jax.Array:
     """Run the controlled denoise loop; returns final latents (P, F, h, w, C).
@@ -163,13 +166,21 @@ def edit_sample(
     telemetry-off program is unchanged (tests/test_obs.py pins the outputs
     bit-exact, cached replay exactness included).
 
+    ``device_probe``: a per-device telemetry probe for sharded runs
+    (:func:`videop2p_tpu.obs.comm.make_device_probe`): called on the
+    post-step latents inside the scan body, its fixed-shape output dict
+    (per-device abs-max/mean/NaN/inf of each device's LOCAL shard plus a
+    cross-replica divergence scalar) rides the scan ``ys`` — the same
+    zero-extra-dispatch contract as ``telemetry``. Off (None) by default;
+    the probe-off program is unchanged.
+
     ``attn_maps=True``: additionally return a per-step attention capture
     record riding the same scan (obs.attention — zero extra dispatches):
     pooled per-token cross-attention heatmaps over the conditional
     streams, per-site attention entropies, and (when a LocalBlend is
     configured) the blend-mask time series with coverage fractions. The
     return is ``latents`` plus the requested records in fixed order:
-    ``(latents[, tel][, attn])``. Off by default — the capture-off
+    ``(latents[, tel][, dev][, attn])``. Off by default — the capture-off
     program is byte-identical (tests/test_quality.py pins it).
     """
     P = cond_embeddings.shape[0]
@@ -230,7 +241,8 @@ def edit_sample(
             uncond_embeddings, cached_source,
             num_inference_steps=num_inference_steps,
             guidance_scale=guidance_scale, ctx=ctx,
-            blend_res=blend_res, telemetry=telemetry, attn_maps=attn_maps,
+            blend_res=blend_res, telemetry=telemetry,
+            device_probe=device_probe, attn_maps=attn_maps,
         )
 
     # the source stream's per-step uncond: the null-text sequence when given,
@@ -362,9 +374,11 @@ def edit_sample(
             latents = jnp.where(
                 active, jnp.broadcast_to(latents[:1], latents.shape), latents
             )
-        tel = attn = None
+        tel = attn = dev = None
         if telemetry:
             tel = dict(latent_stats(latents), **_controller_gates(ctx, i))
+        if device_probe is not None:
+            dev = device_probe(latents)
         if attn_maps:
             attn = attn_step_record(
                 store, num_uncond=U, num_cond=P, video_length=video_length,
@@ -372,7 +386,7 @@ def edit_sample(
             )
             if use_blend:
                 attn.update(_mask_series_entry(maps_sum, ctx.blend, i, latent_hw))
-        ys = _pack_step_outputs(telemetry, tel, attn_maps, attn)
+        ys = _pack_step_outputs(telemetry, tel, attn_maps, attn, dev)
         return (latents, maps_sum, key), ys
 
     xs = (timesteps, jnp.arange(num_inference_steps), uncond0_seq)
@@ -380,6 +394,8 @@ def edit_sample(
     out = (latents,)
     if telemetry:
         out += (ys["tel"],)
+    if device_probe is not None:
+        out += (ys["dev"],)
     if attn_maps:
         out += (ys["attn"],)
     return out if len(out) > 1 else latents
@@ -399,6 +415,7 @@ def _edit_sample_cached(
     ctx: Optional[ControlContext],
     blend_res: Optional[Tuple[int, int]],
     telemetry: bool = False,
+    device_probe: Optional[Callable] = None,
     attn_maps: bool = False,
 ) -> jax.Array:
     """The cached-source denoise loop: only the P−1 edit streams run the
@@ -523,11 +540,13 @@ def _edit_sample_cached(
                 jnp.broadcast_to(src_after, edit_latents.shape),
                 edit_latents,
             )
-        tel = attn = None
+        tel = attn = dev = None
         if telemetry:
             # stats cover the EDIT streams only — the source stream is a
             # replayed constant here, by construction finite and exact
             tel = dict(latent_stats(edit_latents), **_controller_gates(ctx, i))
+        if device_probe is not None:
+            dev = device_probe(edit_latents)
         if attn_maps:
             # heat covers the E edit streams (the source stream is not in
             # the batch — its maps live in the inversion capture record);
@@ -538,7 +557,7 @@ def _edit_sample_cached(
             )
             if use_blend:
                 attn.update(_mask_series_entry(maps_sum, ctx.blend, i, latent_hw))
-        ys = _pack_step_outputs(telemetry, tel, attn_maps, attn)
+        ys = _pack_step_outputs(telemetry, tel, attn_maps, attn, dev)
         return (edit_latents, maps_sum), ys
 
     blend_xs = (
@@ -553,6 +572,8 @@ def _edit_sample_cached(
     outs = (out,)
     if telemetry:
         outs += (ys["tel"],)
+    if device_probe is not None:
+        outs += (ys["dev"],)
     if attn_maps:
         outs += (ys["attn"],)
     return outs if len(outs) > 1 else out
