@@ -369,8 +369,12 @@ def test_report_and_obs_import_only_stdlib_numpy_jax():
               "tensorflow", "flax", "optax", "transformers"}
     files = [os.path.join(_REPO, "tools", "edit_report.py")]
     obs_dir = os.path.join(_REPO, "videop2p_tpu", "obs")
-    files += [os.path.join(obs_dir, f) for f in sorted(os.listdir(obs_dir))
-              if f.endswith(".py")]
+    obs_files = sorted(f for f in os.listdir(obs_dir) if f.endswith(".py"))
+    # ISSUE 6 pins: the time-domain modules are IN the guarded set — the
+    # stdlib xplane reader must never grow a tensorflow path, and the
+    # latency reservoirs must stay stdlib
+    assert {"timing.py", "trace.py"} <= set(obs_files)
+    files += [os.path.join(obs_dir, f) for f in obs_files]
     offenders = []
     for path in files:
         roots = _import_roots(path)
@@ -486,6 +490,51 @@ def test_comm_and_device_ledger_event_schema(tmp_path):
     for entry in m["devices"]:
         assert {"device", "coords", "process_index", "bytes_in_use",
                 "peak_bytes_in_use", "bytes_limit", "live_bytes"} <= set(entry)
+
+
+def test_execute_timing_and_trace_ledger_event_schema(tmp_path):
+    """Schema pin (ISSUE 6): the ``execute_timing`` and ``trace_analysis``
+    ledger events carry their documented field sets — TIMING_RULES, both
+    tools and the HTML report's "Where time goes" section key on these
+    names — and the reservoir summary matches the pin EXACTLY (drift in
+    either direction fails)."""
+    from videop2p_tpu.obs import (
+        EXECUTE_TIMING_FIELDS,
+        TRACE_ANALYSIS_FIELDS,
+        LatencyReservoir,
+        RunLedger,
+        read_ledger,
+    )
+    from videop2p_tpu.obs.trace import analyze_events
+
+    res = LatencyReservoir()
+    for i in range(10):
+        res.add(0.01 + i * 1e-4, 0.02 + i * 1e-4)
+    assert set(res.summary()) == set(EXECUTE_TIMING_FIELDS)
+
+    record, arrays = analyze_events(
+        [("fusion.1", 0, 1_000_000), ("all-reduce.2", 500_000, 1_000_000)],
+        [("jit_m", 0, 2_000_000)],
+        name="w", trace_dir="/tmp/x",
+    )
+    path = str(tmp_path / "ledger.jsonl")
+    with RunLedger(path) as led:
+        led.record_execute("edit", 0.01, 0.02)
+        led.flush_execute_timing()
+        led.event("trace_analysis", sidecar="s.npz", **record)
+    by_kind = {e["event"]: e for e in read_ledger(path)}
+    et = by_kind["execute_timing"]
+    assert set(EXECUTE_TIMING_FIELDS) <= set(et)
+    assert et["program"] == "edit" and et["count"] == 1
+    ta = by_kind["trace_analysis"]
+    assert set(TRACE_ANALYSIS_FIELDS) <= set(ta)
+    assert ta["sidecar"] == "s.npz" and ta["name"] == "w"
+    assert 0.0 <= ta["overlap_fraction"] <= 1.0
+    # the close() flush is idempotent over an already-flushed reservoir:
+    # exactly one more event (same count), not a duplicate explosion
+    events = read_ledger(path)
+    assert [e["count"] for e in events
+            if e["event"] == "execute_timing"] == [1, 1]
 
 
 def test_no_wall_clock_in_timed_regions():

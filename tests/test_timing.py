@@ -1,0 +1,296 @@
+"""Time-domain observability, latency half (ISSUE 6): the bounded
+percentile reservoirs (obs/timing.py), instrumented_jit's opt-in
+per-dispatch execute timing, the execute_timing ledger event, the
+TIMING_RULES regression gates, and the obs_diff acceptance path —
+self-compare exits 0, a scaled-reservoir latency injection exits 1 with
+a machine-readable verdict.
+"""
+
+import importlib.util
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from videop2p_tpu.obs import (
+    EXECUTE_TIMING_FIELDS,
+    TIMING_RULES,
+    LatencyReservoir,
+    RunLedger,
+    evaluate_rules,
+    extract_run,
+    instrumented_jit,
+    percentile,
+    read_ledger,
+    split_runs,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        f"{name}_under_timing_test", os.path.join(_REPO, "tools", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ------------------------------------------------------- reservoirs --
+
+
+def test_percentile_nearest_rank():
+    data = list(range(1, 101))  # 1..100
+    assert percentile(data, 50) == 50
+    assert percentile(data, 95) == 95
+    assert percentile(data, 99) == 99
+    assert percentile(data, 100) == 100
+    assert percentile(data, 0) == 1
+    assert percentile([7.0], 99) == 7.0
+    assert percentile([], 50) == 0.0
+    # every reported value is an OBSERVED sample, never an interpolation
+    assert percentile([1.0, 10.0], 50) in (1.0, 10.0)
+
+
+def test_reservoir_bounded_exact_count_and_max():
+    """Capacity bounds the stored samples; count and the maxima stay
+    exact however many samples flow through — a tail spike can never be
+    sampled away."""
+    r = LatencyReservoir(capacity=8)
+    for i in range(1000):
+        r.add(0.001 * i, 0.002 * i)
+    r.add(5.0, 9.0)  # the spike
+    s = r.summary()
+    assert set(EXECUTE_TIMING_FIELDS) == set(s)
+    assert s["count"] == 1001
+    assert s["sampled"] == 8
+    assert s["dispatch_max_s"] == 5.0
+    assert s["blocked_max_s"] == 9.0
+    assert 0 < s["blocked_p50_s"] <= s["blocked_p99_s"] <= s["blocked_max_s"]
+
+
+def test_reservoir_deterministic_and_scaled():
+    def fill():
+        r = LatencyReservoir(capacity=16)
+        for i in range(500):
+            r.add(0.01 + (i % 37) * 1e-4, 0.02 + (i % 37) * 1e-4)
+        return r
+
+    a, b = fill(), fill()
+    assert a.summary() == b.summary()  # seeded RNG: identical runs agree
+    scaled = a.scaled(1.5)
+    sa, ss = a.summary(), scaled.summary()
+    assert ss["count"] == sa["count"]
+    assert ss["blocked_p50_s"] == pytest.approx(sa["blocked_p50_s"] * 1.5)
+    assert ss["blocked_max_s"] == pytest.approx(sa["blocked_max_s"] * 1.5)
+
+
+def test_reservoir_empty_and_invalid():
+    assert LatencyReservoir().summary() is None
+    with pytest.raises(ValueError):
+        LatencyReservoir(capacity=0)
+
+
+def test_dispatch_fraction_signals_async_overlap():
+    r = LatencyReservoir()
+    for _ in range(10):
+        r.add(0.001, 0.1)  # returns immediately, executes 100 ms
+    assert r.summary()["dispatch_fraction"] == pytest.approx(0.01)
+
+
+# ------------------------------------- instrumented_jit integration --
+
+
+def test_instrumented_jit_timing_on_emits_execute_timing(tmp_path):
+    """--latency path: every dispatch lands in the program's reservoir,
+    the close() flush emits ONE execute_timing event per program with
+    the pinned schema, and program_call events carry blocked_s."""
+    path = str(tmp_path / "ledger.jsonl")
+    f = instrumented_jit(lambda x: x * 2 + 1, program="doubler")
+    with RunLedger(path, latency=True):
+        for _ in range(5):
+            f(jnp.ones((8, 8)))
+    events = read_ledger(path)
+    et = [e for e in events if e["event"] == "execute_timing"]
+    assert len(et) == 1
+    assert et[0]["program"] == "doubler"
+    assert set(EXECUTE_TIMING_FIELDS) <= set(et[0])
+    assert et[0]["count"] == 5
+    assert et[0]["blocked_p50_s"] >= et[0]["dispatch_p50_s"] * 0 and \
+        et[0]["blocked_p50_s"] > 0
+    calls = [e for e in events if e["event"] == "program_call"]
+    assert len(calls) == 5
+    assert all("blocked_s" in c and c["blocked_s"] >= c["dispatch_s"] * 0
+               for c in calls)
+
+
+def test_instrumented_jit_timing_off_is_bit_exact_and_silent(tmp_path):
+    """Timing OFF: outputs identical to the timing-on run bit-for-bit,
+    no execute_timing event, no blocked_s on program_call — and no
+    block_until_ready added to the dispatch path."""
+    import numpy as np
+
+    x = jnp.linspace(0.0, 1.0, 64).reshape(8, 8)
+    f_off = instrumented_jit(lambda v: jnp.tanh(v @ v), program="p_off")
+    f_on = instrumented_jit(lambda v: jnp.tanh(v @ v), program="p_on")
+    path_off = str(tmp_path / "off.jsonl")
+    path_on = str(tmp_path / "on.jsonl")
+    with RunLedger(path_off):
+        out_off = f_off(x)
+    with RunLedger(path_on, latency=True):
+        out_on = f_on(x)
+    assert np.array_equal(np.asarray(out_off), np.asarray(out_on))
+    kinds_off = [e["event"] for e in read_ledger(path_off)]
+    assert "execute_timing" not in kinds_off
+    call_off = next(e for e in read_ledger(path_off)
+                    if e["event"] == "program_call")
+    assert "blocked_s" not in call_off
+
+
+def test_env_var_enables_timing(tmp_path, monkeypatch):
+    monkeypatch.setenv("VIDEOP2P_OBS_LATENCY", "1")
+    path = str(tmp_path / "ledger.jsonl")
+    f = instrumented_jit(lambda x: x + 1, program="env_timed")
+    with RunLedger(path):
+        f(jnp.asarray(1.0))
+    et = [e for e in read_ledger(path) if e["event"] == "execute_timing"]
+    assert len(et) == 1 and et[0]["program"] == "env_timed"
+
+
+def test_flush_mid_run_supersedes(tmp_path):
+    """An explicit mid-run flush plus the close flush: extract_run keeps
+    the LAST summary, which covers every dispatch recorded so far."""
+    path = str(tmp_path / "ledger.jsonl")
+    with RunLedger(path) as led:
+        led.record_execute("prog", 0.01, 0.02)
+        led.flush_execute_timing()
+        led.record_execute("prog", 0.01, 0.02)
+    events = read_ledger(path)
+    et = [e for e in events if e["event"] == "execute_timing"]
+    assert [e["count"] for e in et] == [1, 2]
+    rec = extract_run(events)
+    assert rec["timing"]["prog"]["count"] == 2
+
+
+# ----------------------------------------------- rules + extraction --
+
+
+def _timing_ledger(path, run_id, reservoir, trace_fields=None):
+    led = RunLedger(path, run_id=run_id, device_info=False)
+    led.event("execute_timing", program="edit", **reservoir.summary())
+    if trace_fields:
+        led.event("trace_analysis", **trace_fields)
+    led.close()
+
+
+def _base_reservoir():
+    r = LatencyReservoir()
+    for i in range(64):
+        r.add(0.010 + (i % 7) * 1e-4, 0.100 + (i % 7) * 1e-3)
+    return r
+
+
+def test_extract_run_timing_and_trace_sections(tmp_path):
+    path = str(tmp_path / "a.jsonl")
+    _timing_ledger(path, "a", _base_reservoir(), trace_fields={
+        "name": "edit_window", "trace_dir": "/tmp/t", "sidecar": "s.npz",
+        "device_total_s": 1.5, "compute_s": 1.2, "collective_s": 0.4,
+        "overlap_fraction": 0.75, "span_s": 2.0, "idle_s": 0.1,
+        "idle_max_s": 0.05, "num_events": 100, "num_ops": 10,
+        "module_total_s": 1.6, "module_span_s": 1.9,
+        "families": {"fusion": 1.0}, "top_ops": [{"op": "fusion.1"}],
+    })
+    rec = extract_run(split_runs(read_ledger(path))[0])
+    assert rec["timing"]["edit"]["count"] == 64
+    assert rec["timing"]["edit"]["blocked_p50_s"] > 0
+    t = rec["trace"]["edit_window"]
+    assert t["overlap_fraction"] == 0.75
+    # strings/arrays stay out of the numeric rule surface
+    assert "families" not in t and "sidecar" not in t
+    # pre-PR-6 ledgers: the sections exist and are empty
+    old = extract_run([{"event": "run_start", "run_id": "old"}])
+    assert old["timing"] == {} and old["trace"] == {}
+    assert evaluate_rules(old, old)["pass"]
+
+
+def test_timing_rules_flag_latency_and_overlap_regressions(tmp_path):
+    """p50/p99 growth past 25% regresses; an overlap-fraction DROP past
+    10% regresses (direction=decrease); self-compare is always clean."""
+    base_res = _base_reservoir()
+    a = str(tmp_path / "a.jsonl")
+    b = str(tmp_path / "b.jsonl")
+    trace_a = {"name": "w", "device_total_s": 1.0, "overlap_fraction": 0.8}
+    trace_b = {"name": "w", "device_total_s": 1.35, "overlap_fraction": 0.4}
+    _timing_ledger(a, "a", base_res, trace_fields=trace_a)
+    _timing_ledger(b, "b", base_res.scaled(1.5), trace_fields=trace_b)
+    base = extract_run(split_runs(read_ledger(a))[0])
+    new = extract_run(split_runs(read_ledger(b))[0])
+    assert evaluate_rules(base, base)["pass"]
+    res = evaluate_rules(base, new, TIMING_RULES)
+    assert not res["pass"]
+    regs = {(v["rule"], v["program"]) for v in res["regressions"]}
+    assert ("timing:blocked_p50_s+25%", "edit") in regs
+    assert ("timing:blocked_p99_s+25%", "edit") in regs
+    assert ("trace:device_total_s+20%", "w") in regs
+    assert ("trace:overlap_fraction-10%", "w") in regs
+    # each verdict is machine-readable with base/new/delta
+    for v in res["regressions"]:
+        assert {"rule", "kind", "program", "metric", "base", "new",
+                "regressed"} <= set(v)
+
+
+def test_obs_diff_accepts_self_and_rejects_scaled_reservoir(tmp_path, capsys):
+    """The ISSUE 6 acceptance gate, via the CLI: self-compare exits 0;
+    the +50% scaled-reservoir injection exits 1 and the --json verdict
+    names the timing rule."""
+    mod = _load_tool("obs_diff")
+    base_res = _base_reservoir()
+    a = str(tmp_path / "a.jsonl")
+    b = str(tmp_path / "b.jsonl")
+    _timing_ledger(a, "a", base_res)
+    _timing_ledger(b, "b", base_res.scaled(1.5))
+    assert mod.main(["obs_diff.py", a, a]) == 0
+    out = capsys.readouterr().out
+    assert "no regressions" in out and "execute timing" in out
+    assert mod.main(["obs_diff.py", "--json", a, b]) == 1
+    verdict = json.loads(capsys.readouterr().out)
+    assert verdict["pass"] is False
+    timing_regs = [v for v in verdict["regressions"]
+                   if v["kind"] == "timing"]
+    assert timing_regs and all(v["regressed"] for v in timing_regs)
+
+
+def test_micro_jitter_below_abs_floor_never_regresses(tmp_path):
+    """The min_abs floors: a 50% swing on a 0.1 ms dispatch is host
+    jitter, not a latency regression."""
+    tiny = LatencyReservoir()
+    for _ in range(32):
+        tiny.add(0.0001, 0.0001)
+    a = str(tmp_path / "a.jsonl")
+    b = str(tmp_path / "b.jsonl")
+    _timing_ledger(a, "a", tiny)
+    _timing_ledger(b, "b", tiny.scaled(1.5))
+    base = extract_run(split_runs(read_ledger(a))[0])
+    new = extract_run(split_runs(read_ledger(b))[0])
+    assert evaluate_rules(base, new, TIMING_RULES)["pass"]
+
+
+# -------------------------------------------------- summary renderer --
+
+
+def test_ledger_summary_renders_timing_and_trace_tables(tmp_path):
+    mod = _load_tool("ledger_summary")
+    path = str(tmp_path / "ledger.jsonl")
+    _timing_ledger(path, "render", _base_reservoir(), trace_fields={
+        "name": "edit_window", "device_total_s": 1.5, "compute_s": 1.2,
+        "collective_s": 0.4, "overlap_fraction": 0.75, "idle_s": 0.1,
+        "num_events": 100, "families": {"fusion": 1.0, "dot": 0.2},
+    })
+    text = mod.render(read_ledger(path))
+    assert "execute timing" in text and "edit" in text
+    assert "trace analysis" in text and "edit_window" in text
+    assert "0.75" in text
+    assert "fusion=1.000s" in text

@@ -6,10 +6,13 @@ Usage:  python tools/edit_report.py <ledger.jsonl> [-o report.html]
 Renders the LAST run of the ledger (ledger files append across
 invocations): per-word cross-attention heatmap grids across DDIM steps,
 LocalBlend mask overlays on the edited frames, the null-text loss
-sparkline, the edit-quality table (PSNR/SSIM), and the regression
-verdicts — everything base64-embedded in one HTML file. The sidecar
-``.npz`` is located from the ledger's ``attn_maps``/``quality`` events
-when not given explicitly.
+sparkline, the edit-quality table (PSNR/SSIM), the "Where time goes"
+section (execute-latency distributions + device-trace breakdowns —
+``trace`` events whose directory still exists are auto-mined with the
+stdlib xplane reader, no tensorflow), and the regression verdicts —
+everything base64-embedded in one HTML file. The sidecar ``.npz`` is
+located from the ledger's ``attn_maps``/``quality`` events when not
+given explicitly.
 
 stdlib + numpy only (tests/test_bench_guard.py pins the import closure)
 — runs on any box the ledger was copied to, no plotting stack, no

@@ -14,9 +14,13 @@ Distributed runs additionally get a collective-communication table
 (``comm_analysis`` events — obs/comm.py per-kind counts/bytes), per-device
 telemetry lines with the cross-replica divergence (must be 0.0),
 ``program_analysis_skipped`` reasons, and a per-host phase-skew table when
-``host_phase`` events exist (multi-host straggler visibility). Ledgers
-written before these events existed render exactly as before — the
-sections simply don't appear.
+``host_phase`` events exist (multi-host straggler visibility). Time-domain
+runs (``--latency`` / ``--trace_analysis``) additionally get a per-program
+execute-timing table (blocked-latency p50/p95/p99/max with the
+dispatch-vs-blocked async-overlap split) and a trace-analysis table
+(device/compute/collective seconds, the compute-collective overlap
+fraction, idle time, op families). Ledgers written before these events
+existed render exactly as before — the sections simply don't appear.
 
 Tolerates empty ledgers and truncated/partial JSONL lines (a killed run's
 torn tail): malformed events render as far as their fields allow instead
@@ -158,6 +162,59 @@ def render(events: List[Dict]) -> str:
                 "the partitioned programs):",
                 _table(rows, ["program", "partitions", "collectives",
                               "bytes", "per-kind"])]
+
+    # execute_timing: per-dispatch latency distributions (obs/timing.py
+    # reservoirs behind --latency) — the serving-SLO view of each program
+    timing: Dict[str, Dict] = {}
+    for e in events:
+        if e.get("event") == "execute_timing":
+            timing[e.get("program") or "(unattributed)"] = e
+    if timing:
+        rows = []
+        for prog, t in sorted(timing.items()):
+            rows.append([
+                prog, str(t.get("count", "-")),
+                f"{_f(t.get('blocked_p50_s')) * 1e3:.1f}",
+                f"{_f(t.get('blocked_p95_s')) * 1e3:.1f}",
+                f"{_f(t.get('blocked_p99_s')) * 1e3:.1f}",
+                f"{_f(t.get('blocked_max_s')) * 1e3:.1f}",
+                f"{_f(t.get('dispatch_fraction')):.2f}",
+            ])
+        out += ["", "execute timing (blocked latency ms per dispatch; "
+                "dispatch/blocked ~0 = async overlap working):",
+                _table(rows, ["program", "calls", "p50", "p95", "p99",
+                              "max", "disp/blk"])]
+
+    # trace_analysis: mined device traces (obs/trace.py stdlib xplane
+    # reader) — where device time actually went during the traced window
+    trace_rows = []
+    trace_extra: List[str] = []
+    for e in events:
+        if e.get("event") != "trace_analysis":
+            continue
+        ov = e.get("overlap_fraction")
+        trace_rows.append([
+            e.get("name", "?"),
+            f"{_f(e.get('device_total_s')):.3f}",
+            f"{_f(e.get('compute_s')):.3f}",
+            f"{_f(e.get('collective_s')):.3f}",
+            "-" if ov is None else f"{_f(ov):.2f}",
+            f"{_f(e.get('idle_s')):.3f}",
+            str(e.get("num_events", "-")),
+        ])
+        fams = e.get("families") or {}
+        if isinstance(fams, dict) and fams:
+            top = sorted(fams.items(), key=lambda kv: -_f(kv[1]))[:6]
+            trace_extra.append(
+                f"  {e.get('name', '?')} families: "
+                + ", ".join(f"{k}={_f(v):.3f}s" for k, v in top)
+            )
+    if trace_rows:
+        out += ["", "trace analysis (device time during traced windows; "
+                "overlap = collective time hidden under compute):",
+                _table(trace_rows, ["window", "total_s", "compute_s",
+                                    "collective_s", "overlap", "idle_s",
+                                    "events"])] + trace_extra
 
     skipped: Dict[str, str] = {}
     for e in events:

@@ -9,8 +9,12 @@ Compares the ``program_analysis`` events (XLA cost/memory analysis, HLO
 fingerprints — obs/introspect.py), per-program compile seconds, phase
 wall-clock, collective-communication accounting (``comm_analysis`` events
 — obs/comm.py: per-kind collective counts and byte volumes of the sharded
-programs), per-device peak-HBM residency (``memory`` snapshots), and
-cross-replica divergence (must be 0.0 — the zero-noise-floor invariant)
+programs), per-device peak-HBM residency (``memory`` snapshots), cross-replica
+divergence (must be 0.0 — the zero-noise-floor invariant), per-program
+execute-latency distributions (``execute_timing`` events — obs/timing.py:
+blocked p50/p99 regress by growing), and mined device traces
+(``trace_analysis`` events — obs/trace.py: device-total seconds regress
+by growing, the compute/collective overlap fraction by DROPPING)
 between a baseline run and a new run, renders per-program tables,
 evaluates the declarative regression rules (obs/history.py DEFAULT_RULES;
 scale every threshold with ``--threshold-scale``), and:
@@ -174,6 +178,61 @@ def render_diff(base: Dict, new: Dict, result: Dict) -> str:
                          "ok" if n in (None, 0.0) else "DIVERGED"])
         out += ["", "replica divergence (must be 0.0):",
                 _table(rows, ["label", "base", "new", "verdict"])]
+
+    # time-domain sections (obs/timing.py reservoirs + obs/trace.py
+    # trace mining) — absent/empty for pre-PR-6 ledgers, tables omitted
+    timing = sorted(set(base.get("timing") or {}) | set(new.get("timing") or {}))
+    if timing:
+        rows = []
+        for label in timing:
+            b = (base.get("timing") or {}).get(label, {})
+            n = (new.get("timing") or {}).get(label, {})
+
+            def tcell(metric, scale=1e3, b=b, n=n):
+                bv, nv = b.get(metric), n.get(metric)
+                if bv is None and nv is None:
+                    return "-"
+                if bv is None or nv is None:
+                    return f"{_fmt(bv)} → {_fmt(nv)}"
+                if bv == nv:
+                    return f"{nv * scale:.2f}"
+                pct = (nv / bv - 1.0) * 100.0 if bv else float("inf")
+                return f"{bv * scale:.2f} → {nv * scale:.2f} ({pct:+.1f}%)"
+
+            cnt_b, cnt_n = b.get("count"), n.get("count")
+            cnt = (_fmt(cnt_n) if cnt_b == cnt_n
+                   else f"{_fmt(cnt_b)} → {_fmt(cnt_n)}")
+            rows.append([label, cnt,
+                         tcell("blocked_p50_s"), tcell("blocked_p99_s"),
+                         tcell("blocked_max_s")])
+        out += ["", "execute timing (blocked-latency ms per dispatch):",
+                _table(rows, ["program", "calls", "p50", "p99", "max"])]
+
+    traces = sorted(set(base.get("trace") or {}) | set(new.get("trace") or {}))
+    if traces:
+        rows = []
+        for label in traces:
+            b = (base.get("trace") or {}).get(label, {})
+            n = (new.get("trace") or {}).get(label, {})
+
+            def rcell(metric, b=b, n=n):
+                bv, nv = b.get(metric), n.get(metric)
+                if bv is None and nv is None:
+                    return "-"
+                if bv is None or nv is None:
+                    return f"{_fmt(bv)} → {_fmt(nv)}"
+                if bv == nv:
+                    return _fmt(nv)
+                pct = (nv / bv - 1.0) * 100.0 if bv else float("inf")
+                return f"{_fmt(bv)} → {_fmt(nv)} ({pct:+.1f}%)"
+
+            rows.append([label, rcell("device_total_s"),
+                         rcell("collective_s"), rcell("overlap_fraction"),
+                         rcell("idle_s")])
+        out += ["", "trace analysis (device seconds; overlap regresses "
+                "by dropping):",
+                _table(rows, ["window", "device_total_s", "collective_s",
+                              "overlap", "idle_s"])]
 
     comp = sorted(set(base.get("compiles", {})) | set(new.get("compiles", {})))
     if comp:

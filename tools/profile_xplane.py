@@ -2,11 +2,17 @@
 
 Runs the 50-step inversion + controlled edit (the exact bench working point —
 shared via ``bench.build_fast_edit_working_point``) under ``jax.profiler.trace``
-and sums per-op device time from the raw ``*.xplane.pb`` (the tensorboard-
-plugin converter is broken in this image; parse the proto directly with the
-pure-Python protobuf implementation).
+and sums per-op device time from the raw ``*.xplane.pb``.
 
-Usage:  PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python python tools/profile_xplane.py
+The proto walk now lives in :mod:`videop2p_tpu.obs.trace` — a **stdlib
+wire-format reader**, so this tool no longer needs
+``PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python`` or an installed
+tensorflow, and the same parser feeds the ledger's ``trace_analysis``
+events. Set ``VIDEOP2P_XPLANE_TF=1`` to force the legacy
+tensorflow-proto path (the only reason: validating the stdlib reader
+against the reference decoder on a box that has tensorflow).
+
+Usage:  python tools/profile_xplane.py [trace_dir]
 """
 
 from __future__ import annotations
@@ -14,11 +20,14 @@ from __future__ import annotations
 import collections
 import glob
 import os
-import re
 import sys
 import tempfile
 
-os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from videop2p_tpu.obs.trace import op_family as _op_family  # noqa: E402
 
 
 def iter_device_events(trace_dir: str, line_name: str = "XLA Ops"):
@@ -31,7 +40,24 @@ def iter_device_events(trace_dir: str, line_name: str = "XLA Ops"):
 def iter_device_event_windows(trace_dir: str, line_name: str = "XLA Ops"):
     """Yield ``(op_name, start_ps, duration_ps)`` for every ``line_name``
     line event on a device plane, with starts on the trace's absolute
-    timeline (line timestamp + event offset)."""
+    timeline (line timestamp + event offset).
+
+    Decodes the protos with the stdlib reader (obs/trace.py); the
+    tensorflow-proto fallback survives behind ``VIDEOP2P_XPLANE_TF=1``
+    for cross-validation only.
+    """
+    if os.environ.get("VIDEOP2P_XPLANE_TF", "0") == "1":
+        yield from _iter_device_event_windows_tf(trace_dir, line_name)
+        return
+    from videop2p_tpu.obs.trace import iter_line_events, load_xplanes
+
+    yield from iter_line_events(load_xplanes(trace_dir), line_name)
+
+
+def _iter_device_event_windows_tf(trace_dir: str, line_name: str):
+    """Legacy decoder through the tensorflow protobuf package (requires
+    tensorflow + the pure-Python protobuf implementation)."""
+    os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
     from tensorflow.tsl.profiler.protobuf import xplane_pb2
 
     for path in glob.glob(
@@ -89,20 +115,6 @@ def module_device_span_seconds(trace_dir: str) -> float:
     return (max(e for _, e in starts_ends) - min(s for s, _ in starts_ends)) / 1e12
 
 
-def _op_family(name: str) -> str:
-    """Bucket an XLA op name into a coarse family."""
-    base = name.split(".")[0].split("%")[-1]
-    for fam in (
-        "convolution", "dot", "fusion", "copy", "transpose", "reshape",
-        "reduce", "broadcast", "convert", "all-gather", "all-reduce",
-        "dynamic-slice", "dynamic-update-slice", "scatter", "gather",
-        "custom-call", "rng", "iota", "slice", "concatenate", "pad",
-    ):
-        if base.startswith(fam):
-            return fam
-    return re.sub(r"[-_.]?\d+$", "", base) or base
-
-
 def collect(trace_dir: str) -> dict:
     fams = collections.Counter()
     total_ps = 0
@@ -117,7 +129,6 @@ def main() -> None:
     # proto-parsing CLIs that share it (xplane_top_ops.py)
     import jax
 
-    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     from bench import build_fast_edit_working_point
 
     # profile the CACHED pair (the headline path) unless VIDEOP2P_PROFILE_LIVE=1
@@ -148,6 +159,18 @@ def main() -> None:
     print(f"device op time total: {total:.3f} s")
     for fam, ps in res["families"].most_common(20):
         print(f"  {fam:24s} {ps/1e12:8.3f} s  {ps/res['total_ps']*100:5.1f}%")
+    # the full time-domain record (obs/trace.py): compute vs collective
+    # union seconds, the overlap fraction, idle gaps
+    from videop2p_tpu.obs.trace import analyze_trace_dir
+
+    record, _ = analyze_trace_dir(trace_dir, name="profile_xplane")
+    ov = record["overlap_fraction"]
+    print(
+        f"compute {record['compute_s']:.3f} s / collective "
+        f"{record['collective_s']:.3f} s, overlap "
+        + ("n/a (no collectives)" if ov is None else f"{ov:.2f}")
+        + f", idle {record['idle_s']:.3f} s over a {record['span_s']:.3f} s span"
+    )
 
 
 if __name__ == "__main__":

@@ -1,14 +1,14 @@
 """Top individual XLA ops by device time from an xplane trace dir (see
-profile_xplane.py, which writes the trace and owns the proto walk). Helps
-attribute convert/copy time to specific tensors before optimizing."""
+profile_xplane.py, which writes the trace and owns the proto walk — now
+the stdlib wire-format reader in videop2p_tpu/obs/trace.py, so no
+tensorflow install or protobuf env var is needed). Helps attribute
+convert/copy time to specific tensors before optimizing."""
 
 from __future__ import annotations
 
 import collections
 import os
 import sys
-
-os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from profile_xplane import iter_device_events  # noqa: E402

@@ -184,6 +184,26 @@ def add_obs_args(parser: argparse.ArgumentParser) -> None:
              "requires --mesh; implies a run ledger",
     )
     parser.add_argument(
+        "--latency", action="store_true",
+        help="per-dispatch execute-latency distributions (obs/timing.py): "
+             "every instrumented program accumulates dispatch-return vs "
+             "block-until-ready wall-clock into bounded reservoirs, "
+             "flushed as execute_timing ledger events (p50/p95/p99/max + "
+             "the dispatch-vs-blocked async-overlap split) and gated by "
+             "TIMING_RULES; implies a run ledger. Trades async-dispatch "
+             "overlap for measured end-to-end latency — values bit-exact "
+             "either way",
+    )
+    parser.add_argument(
+        "--trace_analysis", action="store_true",
+        help="capture a jax.profiler device trace around the main phase "
+             "and mine the raw *.xplane.pb with the stdlib reader "
+             "(obs/trace.py — no tensorflow): per-op-family device time, "
+             "top ops, compute/collective overlap fraction and idle gaps "
+             "as a trace_analysis ledger event + .npz sidecar; implies a "
+             "run ledger",
+    )
+    parser.add_argument(
         "--attn_maps", action="store_true",
         help="capture per-step cross-attention observability riding the "
              "fused DDIM scans (obs/attention.py): pooled per-token "

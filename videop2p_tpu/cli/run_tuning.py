@@ -13,6 +13,7 @@ Run:  python -m videop2p_tpu.cli.run_tuning --config configs/rabbit-jump-tune.ya
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import os
 import time
@@ -105,6 +106,14 @@ def main(
     # mesh axes they are replicated on — the invariant a desynced replica
     # breaks silently — and ledger it (divergence must be 0.0; COMM_RULES)
     device_telemetry: bool = False,
+    # time-domain observability (ISSUE 6, obs/timing.py + obs/trace.py):
+    # --latency accumulates per-dispatch (dispatch-return, blocked)
+    # latencies of the train_steps program into bounded reservoirs →
+    # execute_timing ledger events gated by TIMING_RULES;
+    # --trace_analysis wraps the training loop in a jax.profiler capture
+    # mined into a trace_analysis event by the stdlib xplane reader
+    latency: bool = False,
+    trace_analysis: bool = False,
     # automatic XLA cost/memory analysis of each instrumented program on
     # compile (program_analysis ledger events; obs/introspect.py)
     program_analysis: bool = True,
@@ -114,6 +123,8 @@ def main(
     enable_compile_cache()
     if not program_analysis:
         os.environ["VIDEOP2P_OBS_NO_ANALYSIS"] = "1"
+    if latency:
+        os.environ["VIDEOP2P_OBS_LATENCY"] = "1"
     n_frames = int(train_data.get("n_sample_frames", 8))
     output_dir = output_dir + dependent_suffix(
         dependent=dependent, decay_rate=decay_rate, window_size=window_size,
@@ -129,7 +140,7 @@ def main(
     # unified run record (videop2p_tpu/obs): phases, compile events, train
     # metrics and telemetry land in one JSONL stream, line-flushed
     run_ledger = None
-    if telemetry or ledger or device_telemetry:
+    if telemetry or ledger or device_telemetry or latency or trace_analysis:
         from videop2p_tpu.obs import RunLedger
 
         run_ledger = RunLedger(
@@ -137,7 +148,10 @@ def main(
             mesh=mesh,
             meta={"cli": "run_tuning", "max_train_steps": max_train_steps,
                   "telemetry": bool(telemetry),
-                  "device_telemetry": bool(device_telemetry)},
+                  "device_telemetry": bool(device_telemetry),
+                  "latency": bool(latency),
+                  "trace_analysis": bool(trace_analysis)},
+            latency=latency,
         ).activate()
 
     sampler = None
@@ -282,12 +296,28 @@ def main(
     # change the training noise sequence
     key, train_key = jax.random.split(key)
     i = first_step
+    traced_chunk = False
     while i < max_train_steps:
         nxt = min(
             [max_train_steps, i + steps_per_call]
             + [(i // p + 1) * p for p in cadences]
         )
-        out = steps_fn(state, train_key, nxt - i)
+        # --trace_analysis: capture ONE post-compile chunk (the second —
+        # the first is dominated by the scan compile) and mine it into a
+        # trace_analysis ledger event; tracing every chunk would write
+        # gigabytes of xplane protos for a long tune
+        do_trace = trace_analysis and not traced_chunk and i > first_step
+        if do_trace:
+            from videop2p_tpu.obs.trace import trace_window
+
+            chunk_ctx = trace_window("train_steps_chunk")
+        else:
+            chunk_ctx = contextlib.nullcontext()
+        with chunk_ctx:
+            out = steps_fn(state, train_key, nxt - i)
+            if do_trace:
+                jax.block_until_ready(out)  # the capture must hold the work
+                traced_chunk = True
         if telemetry:
             state, chunk_losses, chunk_gnorms = out
             grad_norms.append(chunk_gnorms)
@@ -448,4 +478,6 @@ if __name__ == "__main__":
         ledger=args.ledger,
         program_analysis=not args.no_program_analysis,
         device_telemetry=args.device_telemetry,
+        latency=args.latency,
+        trace_analysis=args.trace_analysis,
     )

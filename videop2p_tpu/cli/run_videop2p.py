@@ -14,6 +14,7 @@ Run:  python -m videop2p_tpu.cli.run_videop2p --config configs/rabbit-jump-p2p.y
 from __future__ import annotations
 
 import argparse
+import contextlib
 import os
 import time
 from typing import Dict, Optional, Sequence, Tuple
@@ -275,6 +276,17 @@ def main(
     # requires --mesh. comm_analysis events (collective counts/bytes) come
     # free with program_analysis on sharded programs.
     device_telemetry: bool = False,
+    # time-domain observability (ISSUE 6): --latency accumulates every
+    # instrumented dispatch's (dispatch-return, block-until-ready)
+    # latencies into bounded per-program reservoirs (obs/timing.py),
+    # flushed as execute_timing ledger events and gated by TIMING_RULES;
+    # --trace_analysis wraps the main edit program in a jax.profiler
+    # capture mined by the stdlib xplane reader (obs/trace.py) into a
+    # trace_analysis event (+ .npz sidecar) with the compute/collective
+    # overlap fraction. Both imply a run ledger; both off paths are
+    # bit-exact (host-side measurement only).
+    latency: bool = False,
+    trace_analysis: bool = False,
     # automatic XLA cost/memory analysis of each instrumented program on
     # compile (program_analysis ledger events; obs/introspect.py) — the
     # per-program peak-HBM estimate the memory snapshots are checked
@@ -311,7 +323,8 @@ def main(
     # telemetry summary and memory snapshot below lands in ONE JSONL stream
     # (events are line-flushed, so a killed run keeps what it measured)
     run_ledger = None
-    if telemetry or ledger or attn_maps or quality or report or device_telemetry:
+    if (telemetry or ledger or attn_maps or quality or report
+            or device_telemetry or latency or trace_analysis):
         from videop2p_tpu import obs
 
         run_ledger = obs.RunLedger(
@@ -322,8 +335,24 @@ def main(
                   "telemetry": bool(telemetry),
                   "attn_maps": bool(attn_maps), "quality": bool(quality),
                   "device_telemetry": bool(device_telemetry),
+                  "latency": bool(latency),
+                  "trace_analysis": bool(trace_analysis),
                   "null_text_precision": null_text_precision},
+            latency=latency,
         ).activate()
+    if latency:
+        # pipeline-internal jits (the fused null-text cache) check the
+        # env, not the wrapper — set it so their dispatches are timed too
+        os.environ["VIDEOP2P_OBS_LATENCY"] = "1"
+
+    def maybe_trace(window_name: str):
+        """--trace_analysis: a mined jax.profiler capture around the
+        named program region; a no-op context otherwise."""
+        if trace_analysis:
+            from videop2p_tpu.obs.trace import trace_window
+
+            return trace_window(window_name)
+        return contextlib.nullcontext()
 
     sampler = None
     if dependent_p2p or (dependent and eta > 0):
@@ -546,7 +575,8 @@ def main(
 
         print("Start Video-P2P!")
         t0 = time.perf_counter()
-        with phase_timer("cached_invert_edit"):
+        with phase_timer("cached_invert_edit"), \
+                maybe_trace("cached_invert_edit"):
             # capture-inversion + controlled edit + VAE decode, one program:
             # the chunked decode alone is 4 host dispatches when run eagerly,
             # each riding the tunnel; telemetry rides the SAME program's
@@ -734,7 +764,7 @@ def main(
         print("Start Video-P2P!")
         key, ek = jax.random.split(key)
         t0 = time.perf_counter()
-        with phase_timer("edit_sample"):
+        with phase_timer("edit_sample"), maybe_trace("edit_sample"):
             out = instrumented_jit(
                 lambda p, x, u, k: edit_sample(
                     unet_fn, p, sched, x, cond_all, u,
@@ -894,4 +924,6 @@ if __name__ == "__main__":
         quality=args.quality,
         report=args.report,
         device_telemetry=args.device_telemetry,
+        latency=args.latency,
+        trace_analysis=args.trace_analysis,
     )

@@ -33,6 +33,16 @@ Pillars (ISSUEs 2–4):
     directories, keys metric series by (program label, HLO fingerprint),
     and evaluates declarative :class:`RegressionRule` thresholds into
     machine-readable verdicts (``tools/obs_diff.py`` is the CLI).
+  * :mod:`videop2p_tpu.obs.timing` — the time domain (ISSUE 6): bounded
+    per-program latency reservoirs behind ``instrumented_jit``'s opt-in
+    execute timing (``--latency`` / ``VIDEOP2P_OBS_LATENCY=1``), flushed
+    as ``execute_timing`` ledger events (dispatch/blocked p50/p95/p99/max
+    + the dispatch-vs-blocked async-overlap split) and gated by
+    ``TIMING_RULES``.
+  * :mod:`videop2p_tpu.obs.trace` — stdlib-only ``*.xplane.pb`` reader
+    (no tensorflow import) + ``trace_window``: per-op-family device
+    time, top-N ops, compute/collective overlap fraction and idle gaps
+    mined into ``trace_analysis`` ledger events with ``.npz`` sidecars.
   * :mod:`videop2p_tpu.obs.comm` — distributed observability (ISSUE 5):
     collective-communication accounting of sharded programs
     (``comm_analysis`` events with per-kind counts/bytes + sharding
@@ -68,6 +78,7 @@ from videop2p_tpu.obs.history import (
     COMM_RULES,
     DEFAULT_RULES,
     QUALITY_RULES,
+    TIMING_RULES,
     RegressionRule,
     RunHistory,
     evaluate_rules,
@@ -104,6 +115,20 @@ from videop2p_tpu.obs.telemetry import (
     summarize_step_stats,
     telemetry_overhead_record,
 )
+from videop2p_tpu.obs.timing import (
+    EXECUTE_TIMING_FIELDS,
+    LatencyReservoir,
+    latency_enabled,
+    measure_overhead_p50,
+    percentile,
+)
+from videop2p_tpu.obs.trace import (
+    TRACE_ANALYSIS_FIELDS,
+    analyze_trace_dir,
+    overlap_fraction,
+    parse_xspace,
+    trace_window,
+)
 
 __all__ = [
     "RunLedger",
@@ -137,6 +162,17 @@ __all__ = [
     "load_obs_sidecar",
     "QUALITY_RULES",
     "COMM_RULES",
+    "TIMING_RULES",
+    "EXECUTE_TIMING_FIELDS",
+    "LatencyReservoir",
+    "latency_enabled",
+    "measure_overhead_p50",
+    "percentile",
+    "TRACE_ANALYSIS_FIELDS",
+    "analyze_trace_dir",
+    "overlap_fraction",
+    "parse_xspace",
+    "trace_window",
     "COLLECTIVE_KINDS",
     "collective_summary",
     "comm_analysis_record",

@@ -39,6 +39,7 @@ __all__ = [
     "DEFAULT_RULES",
     "QUALITY_RULES",
     "COMM_RULES",
+    "TIMING_RULES",
     "split_runs",
     "extract_run",
     "evaluate_rules",
@@ -114,6 +115,25 @@ COMM_RULES: Tuple[RegressionRule, ...] = (
     RegressionRule("value", kind="divergence", direction="nonzero"),
 )
 
+# time-domain gates (ISSUE 6): per-program execute-latency distributions
+# (execute_timing events, obs/timing.py reservoirs) and mined device
+# traces (trace_analysis events, obs/trace.py). Latency regresses by
+# growing — p50 is the serving headline, p99 the SLO tail; small
+# absolute floors keep micro-dispatch jitter out. Trace device-total
+# growing means the chip did more work for the same phase; the
+# compute/collective overlap fraction regresses by DROPPING (a ppermute
+# chain that was hidden under compute becoming exposed).
+TIMING_RULES: Tuple[RegressionRule, ...] = (
+    RegressionRule("blocked_p50_s", kind="timing", threshold_pct=25.0,
+                   min_abs=0.001),
+    RegressionRule("blocked_p99_s", kind="timing", threshold_pct=25.0,
+                   min_abs=0.002),
+    RegressionRule("device_total_s", kind="trace", threshold_pct=20.0,
+                   min_abs=0.05),
+    RegressionRule("overlap_fraction", kind="trace", direction="decrease",
+                   threshold_pct=10.0, min_abs=0.02),
+)
+
 DEFAULT_RULES: Tuple[RegressionRule, ...] = (
     RegressionRule("flops", threshold_pct=10.0),
     RegressionRule("bytes_accessed", threshold_pct=15.0, min_abs=1 << 20),
@@ -122,7 +142,7 @@ DEFAULT_RULES: Tuple[RegressionRule, ...] = (
     RegressionRule("hlo_instructions", threshold_pct=25.0, min_abs=16),
     RegressionRule("seconds", kind="compile", threshold_pct=50.0, min_abs=1.0),
     RegressionRule("seconds", kind="phase", threshold_pct=25.0, min_abs=0.5),
-) + QUALITY_RULES + COMM_RULES
+) + QUALITY_RULES + COMM_RULES + TIMING_RULES
 
 
 def split_runs(events: Iterable[Dict[str, Any]]) -> List[List[Dict[str, Any]]]:
@@ -167,6 +187,9 @@ def extract_run(events: Sequence[Dict[str, Any]],
         "comm": {},
         "device_memory": {},
         "divergence": {},
+        # time-domain sections (ISSUE 6) — likewise empty pre-PR-6
+        "timing": {},
+        "trace": {},
     }
     for e in events:
         kind = e.get("event")
@@ -240,6 +263,23 @@ def extract_run(events: Sequence[Dict[str, Any]],
             rec["divergence"][label] = max(
                 rec["divergence"].get(label, 0.0), val
             )
+        elif kind == "execute_timing":
+            # latest flush supersedes (reservoirs accumulate; the last
+            # summary covers every dispatch recorded so far)
+            label = e.get("program") or "(unattributed)"
+            rec["timing"][label] = {
+                k: v for k, v in e.items()
+                if k not in ("event", "t", "program")
+                and isinstance(v, (int, float)) and not isinstance(v, bool)
+            }
+        elif kind == "trace_analysis":
+            label = e.get("name") or "(unattributed)"
+            rec["trace"][label] = {
+                k: v for k, v in e.items()
+                if k not in ("event", "t", "name", "trace_dir", "sidecar",
+                             "families", "top_ops")
+                and isinstance(v, (int, float)) and not isinstance(v, bool)
+            }
         elif kind == "device_telemetry":
             # the in-scan probe's worst divergence joins the same gate
             label = e.get("program") or "(unattributed)"
@@ -282,6 +322,10 @@ def _rule_values(record: Dict[str, Any], rule: RegressionRule) -> Dict[str, floa
                    for k, v in record.get("device_memory", {}).items()}
     elif rule.kind == "divergence":
         out = {k: float(v) for k, v in record.get("divergence", {}).items()}
+    elif rule.kind in ("timing", "trace"):
+        for label, m in record.get(rule.kind, {}).items():
+            if rule.metric in m:
+                out[label] = float(m[rule.metric])
     if rule.programs is not None:
         out = {k: v for k, v in out.items() if k in rule.programs}
     return out

@@ -161,6 +161,7 @@ class RunLedger:
         mesh: Optional[Any] = None,
         meta: Optional[Dict[str, Any]] = None,
         device_info: bool = True,
+        latency: bool = False,
     ):
         self.path = path
         self.run_id = run_id or uuid.uuid4().hex[:12]
@@ -171,6 +172,13 @@ class RunLedger:
         self._closed = False
         self._activated = False
         self.compile_seconds: List[float] = []  # drained by bench records
+        # per-dispatch execute-timing reservoirs (obs/timing.py): opt-in
+        # via the constructor (the CLIs' --latency) or the process-wide
+        # VIDEOP2P_OBS_LATENCY env var; summaries flush as execute_timing
+        # events on close (or explicitly via flush_execute_timing)
+        self.latency = bool(latency)
+        self._timing: Dict[str, Any] = {}
+        self._timing_lock = threading.Lock()
         _install_compile_listener()
 
         start: Dict[str, Any] = {
@@ -257,6 +265,41 @@ class RunLedger:
         verdict has a zero noise floor."""
         self.event("divergence", label=label, value=float(value), **fields)
 
+    def timing_enabled(self) -> bool:
+        """True when per-dispatch execute timing is on for this run —
+        the constructor flag (--latency) or the process-wide env var."""
+        from videop2p_tpu.obs.timing import latency_enabled
+
+        return self.latency or latency_enabled()
+
+    def record_execute(self, program: str, dispatch_s: float,
+                       blocked_s: float) -> None:
+        """Accumulate one dispatch's (dispatch-return, block-until-ready)
+        latencies into the program's bounded reservoir (obs/timing.py).
+        Nothing is written until :meth:`flush_execute_timing` / close."""
+        from videop2p_tpu.obs.timing import LatencyReservoir
+
+        with self._timing_lock:
+            res = self._timing.get(program)
+            if res is None:
+                res = self._timing[program] = LatencyReservoir()
+        res.add(dispatch_s, blocked_s)
+
+    def flush_execute_timing(self) -> None:
+        """One ``execute_timing`` event per program with recorded
+        dispatches (count, dispatch/blocked p50/p95/p99/max, the
+        dispatch-vs-blocked split). Reservoirs keep accumulating — a
+        later flush supersedes (extract_run keeps the last event)."""
+        with self._timing_lock:
+            items = sorted(self._timing.items())
+        for program, res in items:
+            try:
+                summary = res.summary()
+            except Exception:  # noqa: BLE001 — obs never kills a run
+                continue
+            if summary:
+                self.event("execute_timing", program=program, **summary)
+
     def _on_compile(self, seconds: float, program: Optional[str]) -> None:
         self.compile_seconds.append(float(seconds))
         self.event("compile", seconds=round(float(seconds), 4),
@@ -332,6 +375,10 @@ class RunLedger:
         with self._lock:
             if self._closed:
                 return
+        try:
+            self.flush_execute_timing()
+        except Exception:  # noqa: BLE001 — closing must always succeed
+            pass
         self.event("run_end", compile_events=len(self.compile_seconds))
         with self._lock:
             self._closed = True
@@ -443,14 +490,30 @@ def instrumented_jit(fun, *, program: str, analyze: bool = True, **jit_kwargs):
         with program_label(program):
             out = jitted(*args, **kwargs)
         dt = time.perf_counter() - t0
+        blocked_dt = None
+        if led.timing_enabled():
+            # opt-in only: blocking here trades away async-dispatch
+            # overlap for a measured end-to-end latency — values are
+            # untouched either way (host-side timing cannot change
+            # device results), so the off path stays bit-exact AND
+            # overlap-preserving
+            try:
+                jax.block_until_ready(out)
+                blocked_dt = time.perf_counter() - t0
+                led.record_execute(program, dt, blocked_dt)
+            except Exception:  # noqa: BLE001 — obs never kills a run
+                blocked_dt = None
         miss = None
         if before is not None:
             try:
                 miss = jitted._cache_size() > before
             except Exception:  # noqa: BLE001
                 miss = None
-        led.event("program_call", program=program, cache_miss=miss,
-                  dispatch_s=round(dt, 4))
+        call_fields = {"program": program, "cache_miss": miss,
+                       "dispatch_s": round(dt, 4)}
+        if blocked_dt is not None:
+            call_fields["blocked_s"] = round(blocked_dt, 4)
+        led.event("program_call", **call_fields)
         if miss:
             if skip_reason is None:
                 try:

@@ -16,7 +16,11 @@ the repo's equivalent of Prompt-to-Prompt's ``show_cross_attention``
   * a communication section for sharded runs (``obs/comm.py`` events):
     per-program collective counts/bytes, per-device telemetry with the
     cross-replica divergence verdict (must be 0.0), and per-host phase
-    skew when host_phase events exist.
+    skew when host_phase events exist;
+  * a "Where time goes" section (``obs/timing.py`` / ``obs/trace.py``
+    events): per-program execute-latency distributions and mined
+    device-trace breakdowns — ``trace`` events whose directory still
+    exists on disk are auto-mined at render time.
 
 ``tools/edit_report.py`` is the CLI wrapper. The ledger is parsed with a
 local JSONL reader (not ``obs.ledger``) so this module's import closure
@@ -411,6 +415,78 @@ def _comm_section(events) -> str:
     return "<h2>Distributed / communication</h2>" + "".join(out)
 
 
+def _time_section(events) -> str:
+    """"Where time goes" (ISSUE 6): per-program execute-latency
+    distributions (``execute_timing`` events) and mined device traces
+    (``trace_analysis`` events — including those auto-mined by
+    ``write_report`` from the run's ``trace`` events). Empty for
+    pre-time-domain ledgers."""
+    out: List[str] = []
+
+    timing = {e.get("program") or "?": e for e in events
+              if e.get("event") == "execute_timing"}
+    if timing:
+        rows = []
+        for prog, t in sorted(timing.items()):
+            def ms(key, t=t):
+                v = t.get(key)
+                return f"{v * 1e3:.1f}" if isinstance(v, (int, float)) else "-"
+
+            rows.append([prog, t.get("count"), ms("blocked_p50_s"),
+                         ms("blocked_p95_s"), ms("blocked_p99_s"),
+                         ms("blocked_max_s"), t.get("dispatch_fraction")])
+        out.append(
+            "<h3>Execute latency per program</h3>"
+            "<p class=meta>blocked (end-to-end) dispatch latency in ms "
+            "from the bounded per-program reservoirs (obs/timing.py, "
+            "--latency); dispatch/blocked near 0 means async dispatch is "
+            "overlapping with host work.</p>"
+            + _table(rows, ["program", "calls", "p50", "p95", "p99",
+                            "max", "disp/blk"]))
+
+    trace_evs = [e for e in events if e.get("event") == "trace_analysis"]
+    if trace_evs:
+        rows = []
+        for e in trace_evs:
+            ov = e.get("overlap_fraction")
+            rows.append([e.get("name", "?"), e.get("device_total_s"),
+                         e.get("compute_s"), e.get("collective_s"),
+                         "-" if ov is None else ov, e.get("idle_s"),
+                         e.get("num_events")])
+        out.append(
+            "<h3>Device-trace breakdown</h3>"
+            "<p class=meta>mined from the raw *.xplane.pb protos with the "
+            "stdlib reader (obs/trace.py — no tensorflow); overlap is the "
+            "fraction of collective time hidden under compute "
+            "(1.0 = fully overlapped, 0.0 = fully exposed).</p>"
+            + _table(rows, ["window", "device_s", "compute_s",
+                            "collective_s", "overlap", "idle_s", "events"]))
+        for e in trace_evs:
+            fams = e.get("families") or {}
+            tops = e.get("top_ops") or []
+            bits = []
+            if isinstance(fams, dict) and fams:
+                fam_rows = sorted(
+                    ((k, v) for k, v in fams.items()
+                     if isinstance(v, (int, float))),
+                    key=lambda kv: -kv[1])[:8]
+                bits.append(_table([[k, f"{v:.4f}"] for k, v in fam_rows],
+                                   ["op family", "seconds"]))
+            if tops:
+                top_rows = [[t.get("op", "?")[:90], t.get("seconds"),
+                             t.get("count")] for t in tops[:8]
+                            if isinstance(t, dict)]
+                bits.append(_table(top_rows, ["top op", "seconds", "count"]))
+            if bits:
+                out.append(
+                    f"<h4>{html.escape(str(e.get('name', '?')))}</h4>"
+                    + "".join(bits))
+
+    if not out:
+        return ""
+    return "<h2>Where time goes</h2>" + "".join(out)
+
+
 def _phase_trace_section(events) -> str:
     phases: Dict[str, float] = {}
     for e in events:
@@ -459,6 +535,7 @@ def render_report(events: Sequence[Dict[str, Any]],
         _mask_section(events, sidecar),
         _null_text_section(events),
         _comm_section(events),
+        _time_section(events),
         _verdict_section(events),
         _phase_trace_section(events),
         '<p class=meta>generated by tools/edit_report.py — stdlib+numpy, '
@@ -481,11 +558,44 @@ def _find_sidecar(events, ledger_path: str) -> Optional[str]:
     return None
 
 
+def _mine_trace_events(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """ISSUE 6 satellite: a run that captured device traces via
+    ``utils.profiling.trace`` (VIDEOP2P_TRACE_DIR) recorded only a
+    ``trace`` event (name + directory) — mine any such directory that
+    still exists on disk into a synthetic ``trace_analysis`` event for
+    the "Where time goes" section, instead of silently ignoring it.
+    Windows that already have a ``trace_analysis`` (trace_window runs)
+    are left alone. Best-effort: a missing dir or parse failure skips
+    that trace, never the report."""
+    analyzed = {e.get("name") for e in events
+                if e.get("event") == "trace_analysis"}
+    mined: List[Dict[str, Any]] = []
+    for e in events:
+        if e.get("event") != "trace":
+            continue
+        name, tdir = e.get("name"), e.get("trace_dir")
+        if not tdir or name in analyzed or not os.path.isdir(str(tdir)):
+            continue
+        try:
+            # stdlib-only import closure (obs/trace.py never imports
+            # jax/tensorflow at module level) — the report keeps working
+            # on boxes with nothing but numpy installed
+            from videop2p_tpu.obs.trace import analyze_trace_dir
+
+            record, _ = analyze_trace_dir(str(tdir), name=str(name))
+        except Exception:  # noqa: BLE001 — mining is best-effort
+            continue
+        mined.append({"event": "trace_analysis", "mined_from": "trace",
+                      **record})
+        analyzed.add(name)
+    return events + mined
+
+
 def write_report(ledger_path: str, out_path: Optional[str] = None,
                  sidecar_path: Optional[str] = None) -> str:
     """Render the LAST run of a ledger file (ledgers append across
     invocations) into a self-contained HTML file next to it."""
-    events = _last_run(_read_jsonl(ledger_path))
+    events = _mine_trace_events(_last_run(_read_jsonl(ledger_path)))
     sidecar: Dict[str, np.ndarray] = {}
     sidecar_path = sidecar_path or _find_sidecar(events, ledger_path)
     if sidecar_path and os.path.isfile(sidecar_path):

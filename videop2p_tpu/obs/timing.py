@@ -1,0 +1,211 @@
+"""Per-dispatch execute-latency distributions (the time-domain layer).
+
+Every signal the obs stack measured through PR 5 is either static (XLA
+cost analyses, collective counts, HLO fingerprints) or a coarse
+single-number phase timer. This module adds the missing axis: per
+compiled program, the *distribution* of its execute latencies —
+
+  * ``dispatch`` — how long the jitted call took to RETURN (with async
+    dispatch this is the host-side enqueue cost, not the execution);
+  * ``blocked`` — how long until ``block_until_ready`` on the outputs
+    (the real end-to-end latency of the dispatch).
+
+The dispatch-vs-blocked split is what makes async-dispatch overlap
+visible: a program whose dispatch p50 is a fraction of its blocked p50
+is being successfully overlapped with host work; the two converging
+means the host is serializing on the device.
+
+Samples accumulate in bounded per-program reservoirs
+(:class:`LatencyReservoir` — Algorithm-R reservoir sampling with a
+deterministic per-reservoir RNG, so identical runs summarize
+identically; count and max are tracked exactly outside the sample so a
+tail spike can never be sampled away). Summaries land in the run ledger
+as one ``execute_timing`` event per program (``EXECUTE_TIMING_FIELDS``
+is the schema-stable field set ``obs/history.py``'s ``TIMING_RULES``
+and both CLIs' ``--latency`` flag key on).
+
+Timing is OFF by default: the off path adds one attribute lookup to an
+instrumented dispatch and never blocks, so async pipelines keep their
+overlap and every program's outputs stay bit-exact (timing is purely
+host-side — it cannot change device values in any mode). Enable with
+``--latency`` on either CLI or ``VIDEOP2P_OBS_LATENCY=1``.
+
+Stdlib-only on purpose: the import-guard test walks this file.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import random
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "EXECUTE_TIMING_FIELDS",
+    "RESERVOIR_CAPACITY",
+    "LatencyReservoir",
+    "latency_enabled",
+    "percentile",
+    "measure_overhead_p50",
+]
+
+_LATENCY_ENV = "VIDEOP2P_OBS_LATENCY"
+
+# default bound on stored samples per program: 512 pairs of floats is
+# ~8 KiB — per-program cost stays trivial over arbitrarily long runs
+RESERVOIR_CAPACITY = 512
+
+# schema-stable field set of the execute_timing ledger event
+# (test_bench_guard pins it; history TIMING_RULES reference these names)
+EXECUTE_TIMING_FIELDS = (
+    "count",
+    "sampled",
+    "dispatch_p50_s",
+    "dispatch_p95_s",
+    "dispatch_p99_s",
+    "dispatch_max_s",
+    "blocked_p50_s",
+    "blocked_p95_s",
+    "blocked_p99_s",
+    "blocked_max_s",
+    "dispatch_fraction",
+)
+
+
+def latency_enabled() -> bool:
+    """Process-wide opt-in for per-dispatch execute timing (the CLIs'
+    ``--latency`` sets the env var so pipeline-internal jits see it)."""
+    return os.environ.get(_LATENCY_ENV, "0") == "1"
+
+
+def percentile(samples: Sequence[float], q: float) -> float:
+    """Nearest-rank percentile of a sequence (q in [0, 100]).
+
+    Nearest-rank (not interpolated) so every reported value is an
+    actually-observed latency — a p99 that no dispatch ever exhibited
+    would be noise dressed as evidence. Empty input returns 0.0.
+    """
+    if not samples:
+        return 0.0
+    ordered = sorted(samples)
+    rank = math.ceil(q * len(ordered) / 100.0)  # 1-based nearest rank
+    return ordered[min(max(rank, 1), len(ordered)) - 1]
+
+
+class LatencyReservoir:
+    """Bounded reservoir of ``(dispatch_s, blocked_s)`` pairs.
+
+    Algorithm R: the first ``capacity`` samples are kept verbatim; each
+    later sample replaces a uniformly random slot with probability
+    ``capacity / n``. The RNG is seeded per reservoir, so two identical
+    runs keep identical samples and summarize identically (the property
+    the cross-run obs_diff needs). ``count`` and the component maxima
+    are exact regardless of sampling.
+
+    Thread-safe: dispatches can land from worker threads (the UI
+    trainer, future async serving paths).
+    """
+
+    def __init__(self, capacity: int = RESERVOIR_CAPACITY, seed: int = 0):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self.count = 0
+        self.dispatch_max = 0.0
+        self.blocked_max = 0.0
+        self._samples: List[Tuple[float, float]] = []
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+
+    def add(self, dispatch_s: float, blocked_s: float) -> None:
+        d, b = float(dispatch_s), float(blocked_s)
+        with self._lock:
+            self.count += 1
+            self.dispatch_max = max(self.dispatch_max, d)
+            self.blocked_max = max(self.blocked_max, b)
+            if len(self._samples) < self.capacity:
+                self._samples.append((d, b))
+            else:
+                j = self._rng.randrange(self.count)
+                if j < self.capacity:
+                    self._samples[j] = (d, b)
+
+    def samples(self) -> List[Tuple[float, float]]:
+        with self._lock:
+            return list(self._samples)
+
+    def scaled(self, factor: float) -> "LatencyReservoir":
+        """A copy with every sample (and the maxima) multiplied by
+        ``factor`` — the synthetic-regression injector the acceptance
+        tests use (a +50% latency regression is a scaled reservoir, not
+        a hand-built event)."""
+        out = LatencyReservoir(self.capacity)
+        with self._lock:
+            out.count = self.count
+            out.dispatch_max = self.dispatch_max * factor
+            out.blocked_max = self.blocked_max * factor
+            out._samples = [(d * factor, b * factor)
+                            for d, b in self._samples]
+        return out
+
+    def summary(self) -> Optional[Dict[str, float]]:
+        """The ``execute_timing`` event payload (``EXECUTE_TIMING_FIELDS``),
+        or None when nothing was recorded."""
+        with self._lock:
+            if not self._samples:
+                return None
+            dispatch = [d for d, _ in self._samples]
+            blocked = [b for _, b in self._samples]
+            count, sampled = self.count, len(self._samples)
+            d_max, b_max = self.dispatch_max, self.blocked_max
+        b_p50 = percentile(blocked, 50)
+        d_p50 = percentile(dispatch, 50)
+        return {
+            "count": count,
+            "sampled": sampled,
+            "dispatch_p50_s": round(d_p50, 6),
+            "dispatch_p95_s": round(percentile(dispatch, 95), 6),
+            "dispatch_p99_s": round(percentile(dispatch, 99), 6),
+            "dispatch_max_s": round(d_max, 6),
+            "blocked_p50_s": round(b_p50, 6),
+            "blocked_p95_s": round(percentile(blocked, 95), 6),
+            "blocked_p99_s": round(percentile(blocked, 99), 6),
+            "blocked_max_s": round(b_max, 6),
+            # the async-overlap signal: ~0 = the call returned immediately
+            # and execution proceeded in the background; ~1 = the host
+            # blocked for the full execution inside the dispatch itself
+            "dispatch_fraction": round(d_p50 / b_p50, 4) if b_p50 > 0 else 1.0,
+        }
+
+
+def measure_overhead_p50(run_off, run_on, *, repeats: int = 9
+                         ) -> Dict[str, float]:
+    """Telemetry-overhead comparison on p50s of interleaved reservoirs.
+
+    Replaces the single median-of-N delta the old overhead smoke used
+    (which flaked once in the PR-4 round): both callables warm up once,
+    then the repeats interleave off/on so a drifting machine biases both
+    sides equally, and the record compares nearest-rank p50s from
+    :class:`LatencyReservoir` samples. Returns the same schema as
+    ``obs.telemetry.telemetry_overhead_record`` so existing ledger
+    consumers read it unchanged.
+    """
+    from videop2p_tpu.obs.telemetry import telemetry_overhead_record
+
+    run_off()
+    run_on()
+    off, on = LatencyReservoir(), LatencyReservoir()
+    for _ in range(max(int(repeats), 1)):
+        t0 = time.perf_counter()
+        run_off()
+        dt = time.perf_counter() - t0
+        off.add(dt, dt)
+        t0 = time.perf_counter()
+        run_on()
+        dt = time.perf_counter() - t0
+        on.add(dt, dt)
+    return telemetry_overhead_record(
+        off.summary()["blocked_p50_s"], on.summary()["blocked_p50_s"]
+    )
