@@ -357,10 +357,11 @@ def _import_roots(path):
 
 
 def test_report_and_obs_import_only_stdlib_numpy_jax():
-    """CI satellite (ISSUE 4): tools/edit_report.py and videop2p_tpu/obs/
-    must import only stdlib + numpy + jax (+ the package itself) — no
-    matplotlib/PIL/imageio-only paths — so the report renders and the obs
-    stack decodes on any box, plotting stack or not."""
+    """CI satellite (ISSUEs 4 + 7): tools/edit_report.py,
+    videop2p_tpu/obs/ AND videop2p_tpu/serve/ must import only stdlib +
+    numpy + jax (+ the package itself) — no matplotlib/PIL/imageio-only
+    paths — so the report renders, the obs stack decodes, and the serving
+    engine runs on any box, plotting stack or not."""
     import sys
 
     allowed = set(sys.stdlib_module_names) | {"numpy", "jax", "videop2p_tpu"}
@@ -375,6 +376,14 @@ def test_report_and_obs_import_only_stdlib_numpy_jax():
     # latency reservoirs must stay stdlib
     assert {"timing.py", "trace.py"} <= set(obs_files)
     files += [os.path.join(obs_dir, f) for f in obs_files]
+    # ISSUE 7 pins: the serving subsystem is IN the guarded set — the
+    # HTTP layer stays stdlib http.server/urllib (no flask/requests), and
+    # the engine reaches models only through the package
+    serve_dir = os.path.join(_REPO, "videop2p_tpu", "serve")
+    serve_files = sorted(f for f in os.listdir(serve_dir) if f.endswith(".py"))
+    assert {"engine.py", "store.py", "batching.py", "programs.py",
+            "http.py", "client.py"} <= set(serve_files)
+    files += [os.path.join(serve_dir, f) for f in serve_files]
     offenders = []
     for path in files:
         roots = _import_roots(path)

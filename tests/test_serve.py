@@ -1,0 +1,513 @@
+"""Serving-subsystem tests (ISSUE 7): deterministic batching, the
+byte-budgeted device-resident inversion store, the warm ProgramSet
+(batched == singleton bit-exact), the engine request lifecycle (second
+identical request compile-free with ``src_err == 0.0``), the stdlib HTTP
+API, the loadgen's ``execute_timing``-compatible ledger, and the
+RunLedger concurrent-writer guarantee the multi-threaded engine relies on.
+"""
+
+import importlib.util
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from videop2p_tpu.serve.batching import (
+    bucket_size,
+    compat_key,
+    plan_batches,
+    stack_items,
+    unstack_outputs,
+)
+from videop2p_tpu.serve.store import (
+    InversionStore,
+    load_persisted_inversion,
+    save_persisted_inversion,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        f"{name}_under_serve_test", os.path.join(_REPO, "tools", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class _Item:
+    def __init__(self, compat, tag):
+        self.compat = compat
+        self.tag = tag
+
+    def __repr__(self):
+        return f"Item({self.compat}, {self.tag})"
+
+
+# ------------------------------------------------------------- batching --
+
+
+def test_bucket_size_powers_of_two_capped():
+    assert [bucket_size(n, 8) for n in (1, 2, 3, 4, 5, 7, 8, 9)] == \
+        [1, 2, 4, 4, 8, 8, 8, 8]
+    assert bucket_size(3, 2) == 2  # cap wins over the power-of-two round-up
+
+
+def test_plan_batches_deterministic_grouping_and_padding():
+    items = [_Item("a", 0), _Item("b", 1), _Item("a", 2), _Item("a", 3),
+             _Item("b", 4), _Item("a", 5), _Item("a", 6)]
+    plans = plan_batches(items, max_batch=4)
+    # groups form in first-seen order; items keep submit order; the 5-item
+    # "a" group splits 4+1; every chunk pads to its bucket
+    assert [(p.key, [i.tag for i in p.items], p.padded_size, p.pad)
+            for p in plans] == [
+        ("a", [0, 2, 3, 5], 4, 0),
+        ("a", [6], 1, 0),
+        ("b", [1, 4], 2, 0),
+    ]
+    # identical input -> identical plan (pure function)
+    again = plan_batches(items, max_batch=4)
+    assert [(p.key, [i.tag for i in p.items]) for p in again] == \
+        [(p.key, [i.tag for i in p.items]) for p in plans]
+    # a 3-item group pads to 4 with one repeated entry
+    three = plan_batches([_Item("a", i) for i in range(3)], max_batch=4)
+    assert [(p.padded_size, p.pad) for p in three] == [(4, 1)]
+    # pad=False keeps exact sizes
+    nopad = plan_batches([_Item("a", i) for i in range(3)], max_batch=4,
+                         pad=False)
+    assert [(p.padded_size, p.pad) for p in nopad] == [(3, 0)]
+
+
+def test_stack_unstack_roundtrip_with_padding():
+    trees = [{"x": jnp.full((2, 3), i, jnp.float32), "y": jnp.asarray(i)}
+             for i in range(3)]
+    stacked = stack_items(trees, padded_size=4)
+    assert stacked["x"].shape == (4, 2, 3)
+    # the pad entry repeats the last tree
+    assert np.array_equal(np.asarray(stacked["x"][3]), np.asarray(trees[-1]["x"]))
+    outs = unstack_outputs(stacked, 3)
+    for i, out in enumerate(outs):
+        assert np.array_equal(np.asarray(out["x"]), np.asarray(trees[i]["x"]))
+
+
+def test_compat_key_shape_dtype_and_statics():
+    a = {"x": np.zeros((2, 3), np.float32)}
+    b = {"x": np.ones((2, 3), np.float32)}   # values differ -> same key
+    c = {"x": np.zeros((2, 4), np.float32)}  # shape differs
+    d = {"x": np.zeros((2, 3), np.float16)}  # dtype differs
+    assert compat_key(a) == compat_key(b)
+    assert compat_key(a) != compat_key(c)
+    assert compat_key(a) != compat_key(d)
+    # extra statics (steps, spec fingerprint) discriminate too
+    assert compat_key(a, extra=(50,)) != compat_key(a, extra=(8,))
+
+
+# ---------------------------------------------------------------- store --
+
+
+def _products(mb):
+    return {"traj": np.zeros((mb << 20) // 4, np.float32)}
+
+
+def test_store_lru_eviction_by_byte_budget():
+    store = InversionStore(byte_budget=3 << 20)
+    assert store.put("a", _products(1))
+    assert store.put("b", _products(1))
+    assert store.put("c", _products(1))
+    assert len(store) == 3 and store.stats()["bytes_in_use"] == 3 << 20
+    # touching "a" makes "b" the LRU victim of the next insert
+    assert store.get("a") is not None
+    assert store.put("d", _products(1))
+    assert "b" not in store and {"a", "c", "d"} <= set(store.keys())
+    assert store.stats()["evictions"] == 1
+    # a repeat request is a hit; an evicted key is a miss
+    assert store.get("d") is not None
+    assert store.get("b") is None
+    stats = store.stats()
+    assert stats["hits"] == 2 and stats["misses"] == 1
+
+
+def test_store_oversize_rejected_not_thrashed():
+    store = InversionStore(byte_budget=1 << 20)
+    assert store.put("small", _products(1))  # exactly the budget
+    assert not store.put("big", _products(2))
+    stats = store.stats()
+    assert stats["rejected_oversize"] == 1
+    # the resident entry survived the rejected insert
+    assert store.get("small") is not None
+
+
+def test_store_disk_layer_roundtrip(tmp_path):
+    root = str(tmp_path / "inv_store")
+    traj = np.arange(24, dtype=np.float32).reshape(3, 1, 2, 2, 2)
+    assert load_persisted_inversion(root, "k1") is None
+    save_persisted_inversion(root, "k1", traj, meta={"clip": "x"})
+    got, null = load_persisted_inversion(root, "k1", want_null=True)
+    assert np.array_equal(got, traj) and null is None
+    # write-through from the resident store lands in the same layout
+    store = InversionStore(byte_budget=1 << 20, persist_dir=root)
+    store.put("k2", {"anchor": np.zeros(4, np.float32)}, trajectory=traj)
+    got2, _ = load_persisted_inversion(root, "k2")
+    assert np.array_equal(got2, traj)
+
+
+# ------------------------------------------- ledger concurrent writers --
+
+
+def test_run_ledger_concurrent_writers_no_torn_lines(tmp_path):
+    """ISSUE 7 satellite: multiple in-flight requests share one ledger —
+    concurrent emits (events, execute-timing samples, compile callbacks)
+    must produce only whole, parseable JSONL lines."""
+    from videop2p_tpu.obs import RunLedger, read_ledger
+
+    path = str(tmp_path / "concurrent.jsonl")
+    led = RunLedger(path, meta={"test": "concurrent"})
+    n_threads, n_events = 8, 200
+    barrier = threading.Barrier(n_threads)
+
+    def writer(tid):
+        barrier.wait()
+        for i in range(n_events):
+            led.event("spam", tid=tid, i=i, payload="x" * 64)
+            led.record_execute(f"prog_{tid % 3}", 0.001, 0.002)
+            led._on_compile(0.01, f"prog_{tid % 3}")
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    led.close()
+    raw = open(path).read().splitlines()
+    events = []
+    for line in raw:
+        events.append(json.loads(line))  # a torn line would raise here
+    spam = [e for e in events if e["event"] == "spam"]
+    assert len(spam) == n_threads * n_events
+    # every (tid, i) pair landed exactly once
+    assert len({(e["tid"], e["i"]) for e in spam}) == len(spam)
+    compiles = [e for e in events if e["event"] == "compile"]
+    assert len(compiles) == n_threads * n_events
+    assert len(led.compile_seconds) == n_threads * n_events
+    # reservoirs accumulated under their own lock
+    timing = led.execute_timing_summary()
+    assert sum(t["count"] for t in timing.values()) == n_threads * n_events
+
+
+def test_run_ledger_event_after_close_is_silent(tmp_path):
+    from videop2p_tpu.obs import RunLedger, read_ledger
+
+    path = str(tmp_path / "closed.jsonl")
+    led = RunLedger(path)
+    led.close()
+    led.event("late", x=1)  # must not raise or write
+    assert all(e["event"] != "late" for e in read_ledger(path))
+
+
+# ----------------------------------------------------- sweep satellite --
+
+
+def test_sweep_routes_p2p_through_inv_store():
+    from videop2p_tpu.cli.sweep import cell_commands
+
+    kw = dict(decay_rate=0.1, eta=0.0, dependent_weight=0.0, window_size=8,
+              ar_sample=False, ar_coeff=0.1, num_frames=8, fast=True,
+              dependent_p2p=False, extra=["--tiny"])
+    tune, p2p = cell_commands("t.yaml", "p.yaml", inv_store="shared/inv", **kw)
+    assert "--inv_store" in p2p and p2p[p2p.index("--inv_store") + 1] == "shared/inv"
+    assert "--inv_store" not in tune  # Stage-1 has no inversion reuse path
+    tune2, p2p2 = cell_commands("t.yaml", "p.yaml", inv_store=None, **kw)
+    assert "--inv_store" not in p2p2
+
+
+# ------------------------------------------------- request validation --
+
+
+def test_edit_request_validation_and_json_surface():
+    from videop2p_tpu.serve import EditRequest
+
+    with pytest.raises(ValueError, match="source 'prompt'"):
+        EditRequest(image_path="x", prompts=["a", "b"]).validate()
+    with pytest.raises(ValueError, match=">= 2"):
+        EditRequest(image_path="x", prompt="a", prompts=["a"]).validate()
+    with pytest.raises(ValueError, match="prompts\\[0\\]"):
+        EditRequest(image_path="x", prompt="a", prompts=["b", "c"]).validate()
+    with pytest.raises(ValueError, match="image_path"):
+        EditRequest(prompt="a", prompts=["a", "b"]).validate()
+    with pytest.raises(ValueError, match="unknown request field"):
+        EditRequest.from_dict({"prompt": "a", "bogus": 1})
+    req = EditRequest.from_dict(
+        {"image_path": "x", "prompt": "a", "prompts": ["a", "b"]}
+    )
+    req.validate()
+    assert "frames" not in req.to_dict()
+
+
+# ------------------------------------------------ warm program set -------
+
+_SPEC_KW = dict(checkpoint=None, tiny=True, width=16, video_len=2, steps=2)
+
+
+@pytest.fixture(scope="module")
+def programs():
+    from videop2p_tpu.serve import ProgramSet, ProgramSpec
+
+    return ProgramSet(ProgramSpec(**_SPEC_KW))
+
+
+def _prepare(ps, prompts, clip_phase, blend=None):
+    """Resolve one synthetic request by hand: controller, deterministic
+    clip, encode, capture-inversion. Returns the edit-program arg tree."""
+    ctx = ps.controller(prompts, blend_word=blend)
+    grid = np.arange(2 * 16 * 16 * 3, dtype=np.float64).reshape(2, 16, 16, 3)
+    frames = (np.abs(np.sin(grid * clip_phase)) * 255).astype(np.uint8)
+    key = jax.random.key(0)
+    latents = ps.encode(ps.frames_to_video(frames), key)
+    traj, cached = ps.invert_capture(
+        latents, ps.encode_prompts(prompts[:1]), ctx, key
+    )[:2]
+    return (cached, ps.encode_prompts(prompts), ps.encode_prompts([""])[0],
+            ctx, latents)
+
+
+def test_programset_spec_fingerprint_content_addressed():
+    from videop2p_tpu.serve import ProgramSpec
+
+    a = ProgramSpec(**_SPEC_KW)
+    assert a.fingerprint() == ProgramSpec(**_SPEC_KW).fingerprint()
+    assert a.fingerprint() != ProgramSpec(**{**_SPEC_KW, "steps": 4}).fingerprint()
+    # the tiny-width rule resolves before fingerprinting (512 -> 16)
+    assert ProgramSpec(**{**_SPEC_KW, "width": 512}).fingerprint() == a.fingerprint()
+
+
+def test_batched_scan_dispatch_bit_exact_vs_singleton(programs):
+    """The acceptance pin: two compatible requests (different prompts AND
+    different clips) stacked into one scan-mode dispatch produce BIT-EXACT
+    outputs vs their singleton dispatches, and the exact source replay
+    (src_err == 0.0) survives batching."""
+    a = _prepare(programs, ["a rabbit is jumping", "a origami rabbit is jumping"], 0.013)
+    b = _prepare(programs, ["a cat is running", "a plush cat is running"], 0.071)
+    assert compat_key(a) == compat_key(b)
+    va, ea = programs.edit_decode(*a)
+    vb, eb = programs.edit_decode(*b)
+    stacked = stack_items([a, b], 2)
+    vbat, ebat = programs.edit_decode_batch(stacked, 2, dispatch="scan")
+    assert np.array_equal(np.asarray(va), np.asarray(vbat[0]))
+    assert np.array_equal(np.asarray(vb), np.asarray(vbat[1]))
+    assert [float(x) for x in (ea, eb, ebat[0], ebat[1])] == [0.0] * 4
+    # padding repeats the last item without touching real outputs
+    padded = stack_items([a], 2)
+    vpad, _ = programs.edit_decode_batch(padded, 2, dispatch="scan")
+    assert np.array_equal(np.asarray(va), np.asarray(vpad[0]))
+
+
+def test_batched_vmap_dispatch_allclose(programs):
+    a = _prepare(programs, ["a rabbit is jumping", "a origami rabbit is jumping"], 0.013)
+    b = _prepare(programs, ["a cat is running", "a plush cat is running"], 0.071)
+    va, _ = programs.edit_decode(*a)
+    vb, _ = programs.edit_decode(*b)
+    vbat, errs = programs.edit_decode_batch(
+        stack_items([a, b], 2), 2, dispatch="vmap"
+    )
+    assert np.allclose(np.asarray(va), np.asarray(vbat[0]), atol=1e-5)
+    assert np.allclose(np.asarray(vb), np.asarray(vbat[1]), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(errs), 0.0, atol=1e-5)
+
+
+def test_blend_structure_gets_its_own_compat_key(programs):
+    plain = _prepare(programs, ["a rabbit is jumping", "a origami rabbit is jumping"], 0.013)
+    blended = _prepare(
+        programs, ["a rabbit is jumping", "a origami rabbit is jumping"],
+        0.013, blend=["rabbit", "rabbit"],
+    )
+    assert compat_key(plain) != compat_key(blended)
+    # and the blended structure still dispatches (its own program)
+    v, err = programs.edit_decode(*blended)
+    assert v.shape[0] == 2 and float(err) == 0.0
+
+
+@pytest.mark.slow
+def test_data_mesh_vmap_batch_allclose():
+    """dp>1 serving mesh: the batched vmap dispatch shards the request
+    axis over 'data' and matches unsharded singleton results."""
+    from videop2p_tpu.serve import ProgramSet, ProgramSpec
+
+    ps1 = ProgramSet(ProgramSpec(**_SPEC_KW))
+    ps2 = ProgramSet(ProgramSpec(**_SPEC_KW, mesh="2,1,1"))
+    assert ps2.mesh is not None and ps2.data_axis_size == 2
+    a = _prepare(ps1, ["a rabbit is jumping", "a origami rabbit is jumping"], 0.013)
+    b = _prepare(ps1, ["a cat is running", "a plush cat is running"], 0.071)
+    va, _ = ps1.edit_decode(*a)
+    vb, _ = ps1.edit_decode(*b)
+    vbat, _ = ps2.edit_decode_batch(stack_items([a, b], 2), 2, dispatch="vmap")
+    assert np.allclose(np.asarray(va), np.asarray(vbat[0]), atol=1e-5)
+    assert np.allclose(np.asarray(vb), np.asarray(vbat[1]), atol=1e-5)
+
+
+# ----------------------------------------------------------- engine ------
+
+
+@pytest.fixture(scope="module")
+def engine(tmp_path_factory):
+    from videop2p_tpu.serve import EditEngine, ProgramSpec
+
+    root = tmp_path_factory.mktemp("serve")
+    eng = EditEngine(
+        ProgramSpec(**_SPEC_KW),
+        out_dir=str(root / "out"),
+        store_budget_bytes=64 << 20,
+        persist_dir=str(root / "inv_store"),
+        max_batch=4,
+        max_wait_s=0.3,
+        keep_videos=True,
+    )
+    eng.warm(("a rabbit is jumping", "a origami rabbit is jumping"),
+             batch_sizes=(2,))
+    yield eng
+    eng.close()
+
+
+def _rabbit_request(**overrides):
+    from videop2p_tpu.serve import EditRequest
+
+    kw = dict(image_path="data/rabbit", prompt="a rabbit is jumping",
+              prompts=["a rabbit is jumping", "a origami rabbit is jumping"],
+              save_name="origami")
+    kw.update(overrides)
+    return EditRequest(**kw)
+
+
+def test_engine_second_identical_request_is_compile_free(engine):
+    """THE acceptance criterion: with the engine warm, a repeat identical
+    edit completes with ZERO new compile events (warm ProgramSet +
+    inversion-store hit), its source stream replays with src_err == 0.0,
+    and its outputs are bit-identical to the first run's."""
+    r1 = engine.submit(_rabbit_request())
+    rec1 = engine.result(r1, wait_s=300.0)
+    assert rec1["status"] == "done", rec1.get("error")
+    assert rec1["store_hit"] is False
+    assert rec1["src_err"] == 0.0
+    assert os.path.isfile(rec1["edit_gif"])
+    assert os.path.isfile(rec1["inversion_gif"])
+
+    r2 = engine.submit(_rabbit_request())
+    rec2 = engine.result(r2, wait_s=300.0)
+    assert rec2["status"] == "done", rec2.get("error")
+    assert rec2["store_hit"] is True
+    assert rec2["compile_events"] == 0
+    assert rec2["src_err"] == 0.0
+    assert np.array_equal(engine.videos(r1), engine.videos(r2))
+    # the store's trajectory write-through landed in the disk layer
+    hit_key = rec2["store_key"]
+    traj, _ = load_persisted_inversion(engine.store.persist_dir, hit_key)
+    # (steps+1, B=1, F, h, w, C) in inversion-walk order
+    assert traj is not None and traj.ndim == 6
+    assert traj.shape[0] == engine.spec.steps + 1
+
+
+def test_engine_batches_concurrent_compatible_requests(engine):
+    """Three compatible requests submitted together dispatch as one
+    batched program (the 0.3 s admit window collects them before any
+    resolve starts), bit-equal to the earlier singleton result for the
+    repeated clip."""
+    reqs = [
+        _rabbit_request(),                      # store hit
+        _rabbit_request(seed=7),                # distinct key -> fresh invert
+        _rabbit_request(image_path="data/car",
+                        prompt="a car is moving",
+                        prompts=["a car is moving", "a toy car is moving"]),
+    ]
+    rids = [engine.submit(r) for r in reqs]
+    recs = [engine.result(r, wait_s=300.0) for r in rids]
+    for rec in recs:
+        assert rec["status"] == "done", rec.get("error")
+        assert rec["src_err"] == 0.0
+    assert all(rec["batch_size"] == 3 for rec in recs)
+    assert all(rec["padded_size"] == 4 for rec in recs)
+
+
+def test_engine_metrics_report_reservoir_latency(engine):
+    m = engine.metrics()
+    lat = m["request_latency"]
+    assert lat is not None and lat["count"] >= 2
+    assert lat["blocked_p50_s"] > 0.0 and lat["blocked_p99_s"] > 0.0
+    assert "serve_edit" in m["programs"] and "serve_resolve" in m["programs"]
+    assert m["store"]["hits"] >= 1 and m["store"]["entries"] >= 1
+    assert m["compile"]["events"] > 0  # the warm-up compiles were recorded
+    assert m["requests"].get("done", 0) >= 2
+
+
+def test_engine_bad_request_fails_cleanly(engine):
+    rid = engine.submit(_rabbit_request(image_path="data/does_not_exist"))
+    rec = engine.result(rid, wait_s=120.0)
+    assert rec["status"] == "error"
+    assert "resolve failed" in rec["error"]
+    # the engine worker survived — a good request still completes
+    rec2 = engine.result(engine.submit(_rabbit_request()), wait_s=300.0)
+    assert rec2["status"] == "done"
+
+
+def test_http_roundtrip_and_metrics(engine):
+    from videop2p_tpu.serve.client import EngineClient, engine_available
+    from videop2p_tpu.serve.http import make_server
+
+    server = make_server(engine).start()
+    try:
+        client = EngineClient(server.url)
+        assert engine_available(server.url)
+        health = client.healthz()
+        assert health["ok"] and health["warm"]["src_err"] == 0.0
+        rid = client.submit(_rabbit_request().to_dict())
+        rec = client.wait(rid, timeout_s=300.0)
+        assert rec["status"] == "done" and rec["store_hit"] is True
+        assert rec["compile_events"] == 0 and rec["src_err"] == 0.0
+        # server-side wait endpoint returns the same terminal record
+        rec_srv = client.result(rid, wait_s=5.0)
+        assert rec_srv["status"] == "done" and rec_srv["id"] == rec["id"]
+        metrics = client.metrics()
+        assert metrics["request_latency"]["blocked_p99_s"] > 0.0
+        # error surfaces: unknown id -> 404, malformed request -> 400
+        with pytest.raises(RuntimeError, match="404"):
+            client.poll("feedfacefeed")
+        with pytest.raises(RuntimeError, match="400"):
+            client.submit({"prompt": "a", "bogus": True})
+    finally:
+        server.close()
+    assert not engine_available(server.url)
+    assert not engine_available(None)
+
+
+def test_loadgen_writes_obs_diff_compatible_ledger(engine, tmp_path):
+    loadgen = _load_tool("serve_loadgen")
+    target = loadgen._InprocTarget(engine, timeout_s=300.0)
+    ledger_path = str(tmp_path / "loadgen.jsonl")
+    record = loadgen.run_loadgen(
+        target,
+        _rabbit_request().to_dict(),
+        requests=3, concurrency=2, ledger_path=ledger_path,
+        meta={"target": "test"},
+    )
+    assert record["done"] == 3 and record["errors"] == 0
+    assert record["store_hits"] >= 2  # same clip: everything after #1 hits
+    assert record["latency"]["count"] == 3
+    assert record["latency"]["blocked_p50_s"] > 0.0
+
+    from videop2p_tpu.obs import read_ledger
+    from videop2p_tpu.obs.history import extract_run, split_runs
+
+    runs = split_runs(read_ledger(ledger_path))
+    assert len(runs) == 1
+    timing = extract_run(runs[0]).get("timing", {})
+    assert "loadgen_request" in timing
+    assert timing["loadgen_request"]["count"] == 3
+    # the ledger gates with obs_diff like any other run record
+    obs_diff = _load_tool("obs_diff")
+    assert obs_diff.main(["obs_diff.py", ledger_path, ledger_path]) == 0

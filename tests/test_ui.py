@@ -127,6 +127,87 @@ def test_inference_rejects_mismatched_inv_latent(tmp_path, monkeypatch):
     assert tuple(got.shape) != expected
 
 
+def test_trainer_run_p2p_prefers_engine_then_falls_back(tmp_path, monkeypatch):
+    """ISSUE 7 satellite: with a healthy serving engine the Edit tab's
+    run_p2p never spawns a subprocess; an unavailable/failed engine falls
+    back to the subprocess CLI path unchanged."""
+    import videop2p_tpu.ui.inference as inference_mod
+
+    t = Trainer(experiments_dir=str(tmp_path / "exp"),
+                checkpoint_dir=str(tmp_path / "ck"))
+    exp = tmp_path / "exp" / "demo"
+    exp.mkdir(parents=True)
+    launches = []
+    monkeypatch.setattr(
+        t, "_launch", lambda *a, **k: (launches.append(a), 0)[1]
+    )
+    kw = dict(output_dir=str(exp), video_path="data/rabbit",
+              training_prompt="a rabbit is jumping",
+              editing_prompt="a origami rabbit is jumping")
+
+    served = []
+    monkeypatch.setattr(
+        inference_mod, "edit_via_engine",
+        lambda url, cfg, **k: (served.append((url, cfg)), "served.gif")[1],
+    )
+    out = t.run_p2p(engine_url="http://fake:8000", **kw)
+    assert out == exp.as_posix()
+    assert served and not launches  # engine handled it, no subprocess
+    url, cfg = served[0]
+    assert url == "http://fake:8000"
+    assert cfg["prompts"][1] == "a origami rabbit is jumping"
+
+    # engine says "fall back" (None) -> the subprocess path runs
+    monkeypatch.setattr(inference_mod, "edit_via_engine",
+                        lambda url, cfg, **k: None)
+    t.run_p2p(engine_url="http://fake:8000", **kw)
+    assert len(launches) == 1
+    # no engine configured at all -> straight to subprocess
+    monkeypatch.delenv("VIDEOP2P_SERVE_URL", raising=False)
+    t.run_p2p(**kw)
+    assert len(launches) == 2
+
+
+def test_edit_via_engine_fallback_semantics(monkeypatch):
+    """edit_via_engine returns None (= use the subprocess) for an absent
+    engine, a failed request, or an error record — and the gif path on
+    success."""
+    import videop2p_tpu.serve.client as client_mod
+    from videop2p_tpu.ui.inference import edit_via_engine
+
+    cfg = {"image_path": "data/rabbit", "prompt": "a",
+           "prompts": ["a", "b"], "save_name": "x",
+           "pretrained_model_path": "ignored", "video_len": 8}
+    assert edit_via_engine(None, cfg) is None
+    monkeypatch.setattr(client_mod, "engine_available", lambda url, **k: False)
+    assert edit_via_engine("http://down", cfg) is None
+
+    class FakeClient:
+        def __init__(self, url, **k):
+            self.url = url
+
+        def submit(self, request):
+            # engine-irrelevant fields were stripped before the wire
+            assert "pretrained_model_path" not in request
+            assert "video_len" not in request
+            return "abc123"
+
+        def wait(self, rid, **k):
+            return {"status": "done", "edit_gif": "/srv/out.gif",
+                    "total_s": 0.1, "store_hit": True, "compile_events": 0}
+
+    monkeypatch.setattr(client_mod, "engine_available", lambda url, **k: True)
+    monkeypatch.setattr(client_mod, "EngineClient", FakeClient)
+    assert edit_via_engine("http://up", cfg) == "/srv/out.gif"
+
+    class ErrorClient(FakeClient):
+        def wait(self, rid, **k):
+            return {"status": "error", "error": "boom"}
+
+    monkeypatch.setattr(client_mod, "EngineClient", ErrorClient)
+    assert edit_via_engine("http://up", cfg) is None
+
+
 def test_metrics_logger_jsonl(tmp_path):
     from videop2p_tpu.utils.metrics import MetricsLogger
 

@@ -1,0 +1,237 @@
+"""Closed-loop load generator for the edit-serving engine.
+
+Drives N requests at a fixed concurrency against a running engine — over
+HTTP (``--url``, a ``cli/serve.py`` process) or fully in-process
+(``--inproc``, builds a tiny/random-init engine; the CI smoke mode) — and
+writes an ``execute_timing``-compatible run ledger: per-phase client-side
+latency reservoirs (``loadgen_request`` end-to-end, ``loadgen_submit``)
+flushed through the same :class:`~videop2p_tpu.obs.timing.LatencyReservoir`
+machinery every other run record uses. Two loadgen ledgers therefore diff
+and GATE with ``tools/obs_diff.py`` (``TIMING_RULES``) like any bench run:
+
+    python tools/serve_loadgen.py --url http://host:8000 --requests 64 \
+        --concurrency 8 --image data/rabbit --ledger loadgen_a.jsonl
+    python tools/obs_diff.py loadgen_a.jsonl loadgen_b.jsonl
+
+Closed loop = each worker submits its next request only after the previous
+one finished — the concurrency IS the offered load, so latency percentiles
+are comparable across runs without open-loop arrival modeling.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+class _HttpTarget:
+    def __init__(self, url: str, timeout_s: float):
+        from videop2p_tpu.serve.client import EngineClient
+
+        self.client = EngineClient(url)
+        self.timeout_s = timeout_s
+
+    def one(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        t0 = time.perf_counter()
+        rid = self.client.submit(request)
+        submit_s = time.perf_counter() - t0
+        rec = self.client.wait(rid, timeout_s=self.timeout_s)
+        rec["_submit_s"] = submit_s
+        rec["_e2e_s"] = time.perf_counter() - t0
+        return rec
+
+
+class _InprocTarget:
+    def __init__(self, engine, timeout_s: float):
+        self.engine = engine
+        self.timeout_s = timeout_s
+
+    def one(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        from videop2p_tpu.serve.engine import EditRequest
+
+        t0 = time.perf_counter()
+        rid = self.engine.submit(EditRequest.from_dict(request))
+        submit_s = time.perf_counter() - t0
+        rec = self.engine.result(rid, wait_s=self.timeout_s)
+        rec["_submit_s"] = submit_s
+        rec["_e2e_s"] = time.perf_counter() - t0
+        return rec
+
+
+def run_loadgen(
+    target,
+    request: Dict[str, Any],
+    *,
+    requests: int,
+    concurrency: int,
+    ledger_path: Optional[str] = None,
+    meta: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Run the closed loop; returns the summary record (also printed as one
+    JSON line by :func:`main`). When ``ledger_path`` is given, the
+    reservoirs flush there as ``execute_timing`` events."""
+    from videop2p_tpu.obs.timing import LatencyReservoir
+
+    reservoirs = {
+        "loadgen_request": LatencyReservoir(),
+        "loadgen_submit": LatencyReservoir(),
+    }
+    lock = threading.Lock()
+    counters = {"done": 0, "errors": 0, "store_hits": 0, "issued": 0}
+
+    def worker():
+        while True:
+            with lock:
+                if counters["issued"] >= requests:
+                    return
+                counters["issued"] += 1
+            try:
+                rec = target.one(dict(request))
+            except Exception as e:  # noqa: BLE001 — a failed request is a counter, not a crash
+                with lock:
+                    counters["errors"] += 1
+                print(f"[loadgen] request failed: {e}", file=sys.stderr)
+                continue
+            with lock:
+                if rec.get("status") == "done":
+                    counters["done"] += 1
+                    if rec.get("store_hit"):
+                        counters["store_hits"] += 1
+                else:
+                    counters["errors"] += 1
+            reservoirs["loadgen_request"].add(rec["_e2e_s"], rec["_e2e_s"])
+            reservoirs["loadgen_submit"].add(rec["_submit_s"], rec["_submit_s"])
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(max(int(concurrency), 1))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall_s = time.perf_counter() - t0
+
+    summaries = {name: res.summary() for name, res in reservoirs.items()
+                 if res.summary()}
+    record = {
+        "requests": requests,
+        "concurrency": concurrency,
+        "done": counters["done"],
+        "errors": counters["errors"],
+        "store_hits": counters["store_hits"],
+        "wall_s": round(wall_s, 4),
+        "throughput_rps": round(counters["done"] / wall_s, 4) if wall_s else None,
+        "latency": summaries.get("loadgen_request"),
+    }
+    if ledger_path:
+        from videop2p_tpu.obs import RunLedger
+
+        led = RunLedger(
+            ledger_path,
+            meta={"cli": "serve_loadgen", **(meta or {}),
+                  "requests": requests, "concurrency": concurrency},
+        )
+        for name, res in reservoirs.items():
+            for d, b in res.samples():
+                led.record_execute(name, d, b)
+        led.event("loadgen_summary", **{k: v for k, v in record.items()
+                                        if k != "latency"})
+        led.close()  # flushes execute_timing events
+        record["ledger"] = ledger_path
+    return record
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    target_group = ap.add_mutually_exclusive_group(required=True)
+    target_group.add_argument("--url", type=str,
+                              help="base URL of a running cli/serve.py engine")
+    target_group.add_argument("--inproc", action="store_true",
+                              help="build an in-process engine (tiny/"
+                                   "random-init smoke mode)")
+    ap.add_argument("--requests", type=int, default=16)
+    ap.add_argument("--concurrency", type=int, default=4)
+    ap.add_argument("--timeout_s", type=float, default=600.0)
+    ap.add_argument("--image", type=str, default="data/rabbit")
+    ap.add_argument("--prompt", type=str, default="a rabbit is jumping")
+    ap.add_argument("--edit_prompt", type=str,
+                    default="a origami rabbit is jumping")
+    ap.add_argument("--distinct_seeds", action="store_true",
+                    help="vary the request seed per issue index so every "
+                         "request MISSES the inversion store (cold-path "
+                         "load) instead of hitting after the first")
+    ap.add_argument("--ledger", type=str, default="loadgen_ledger.jsonl")
+    # in-process engine knobs (smoke mode)
+    ap.add_argument("--tiny", action="store_true", default=None)
+    ap.add_argument("--steps", type=int, default=4)
+    ap.add_argument("--video_len", type=int, default=2)
+    ap.add_argument("--width", type=int, default=512)
+    ap.add_argument("--checkpoint", type=str, default=None)
+    ap.add_argument("--max_batch", type=int, default=4)
+    args = ap.parse_args(argv)
+
+    request = {
+        "image_path": args.image,
+        "prompt": args.prompt,
+        "prompts": [args.prompt, args.edit_prompt],
+        "save_name": "loadgen",
+    }
+    engine = None
+    if args.url:
+        target = _HttpTarget(args.url, args.timeout_s)
+        meta = {"target": args.url}
+    else:
+        from videop2p_tpu.cli.common import enable_compile_cache
+        from videop2p_tpu.serve import EditEngine, ProgramSpec
+
+        enable_compile_cache()
+        tiny = True if args.tiny is None else args.tiny
+        engine = EditEngine(
+            ProgramSpec(checkpoint=args.checkpoint, tiny=tiny,
+                        steps=args.steps, video_len=args.video_len,
+                        width=args.width),
+            out_dir="loadgen_out", max_batch=args.max_batch,
+        )
+        engine.warm((args.prompt, args.edit_prompt),
+                    batch_sizes=(min(2, args.max_batch),))
+        target = _InprocTarget(engine, args.timeout_s)
+        meta = {"target": "inproc", "tiny": tiny, "steps": args.steps}
+
+    if args.distinct_seeds:
+        # closed-loop cold traffic: unique seed per request index
+        issue_lock = threading.Lock()
+        counter = {"n": 0}
+        base_one = target.one
+
+        def one_with_seed(req):
+            with issue_lock:
+                counter["n"] += 1
+                req = dict(req, seed=counter["n"])
+            return base_one(req)
+
+        target.one = one_with_seed
+
+    try:
+        record = run_loadgen(
+            target, request,
+            requests=args.requests, concurrency=args.concurrency,
+            ledger_path=args.ledger, meta=meta,
+        )
+    finally:
+        if engine is not None:
+            engine.close()
+    print(json.dumps(record, default=str))
+    return 1 if record["errors"] else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

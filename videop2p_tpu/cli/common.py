@@ -28,9 +28,64 @@ __all__ = [
     "build_models",
     "encode_prompts",
     "enable_compile_cache",
+    "make_run_ledger",
     "setup_mesh",
     "ModelBundle",
 ]
+
+
+def make_run_ledger(
+    default_path: str,
+    *,
+    ledger: Optional[str] = None,
+    mesh: Optional[str] = None,
+    meta: Optional[Dict[str, Any]] = None,
+    telemetry: bool = False,
+    attn_maps: bool = False,
+    quality: bool = False,
+    report: bool = False,
+    device_telemetry: bool = False,
+    latency: bool = False,
+    trace_analysis: bool = False,
+    program_analysis: bool = True,
+    enable: bool = False,
+    set_latency_env: bool = True,
+):
+    """The shared obs-flags → :class:`~videop2p_tpu.obs.RunLedger` wiring.
+
+    Both CLIs, the serving engine and the load generator previously carried
+    (or would have carried) near-identical copies of this block: decide
+    whether any observability flag implies a ledger, resolve the default
+    path, set the process-wide env knobs the pipeline-internal jits check,
+    and ACTIVATE the ledger so ``phase_timer`` / the compile listener /
+    ``instrumented_jit`` find it. Returns the activated ledger, or None
+    when nothing asked for one. ``set_latency_env=False`` keeps ``--latency``
+    scoped to this ledger's lifetime (long-lived in-process engines) instead
+    of flipping the process-wide env var.
+    """
+    if not program_analysis:
+        os.environ["VIDEOP2P_OBS_NO_ANALYSIS"] = "1"
+    if not (enable or telemetry or ledger or attn_maps or quality or report
+            or device_telemetry or latency or trace_analysis):
+        return None
+    if latency and set_latency_env:
+        # pipeline-internal jits (the fused null-text cache) check the
+        # env, not the wrapper — set it so their dispatches are timed too
+        os.environ["VIDEOP2P_OBS_LATENCY"] = "1"
+    from videop2p_tpu.obs import RunLedger
+
+    base_meta = {
+        "telemetry": bool(telemetry),
+        "attn_maps": bool(attn_maps),
+        "quality": bool(quality),
+        "device_telemetry": bool(device_telemetry),
+        "latency": bool(latency),
+        "trace_analysis": bool(trace_analysis),
+    }
+    base_meta.update(meta or {})
+    return RunLedger(
+        ledger or default_path, mesh=mesh, meta=base_meta, latency=latency
+    ).activate()
 
 
 def enable_compile_cache(env_var: str = "VIDEOP2P_COMPILE_CACHE") -> None:

@@ -30,6 +30,7 @@ from videop2p_tpu.cli.common import (
     dependent_suffix,
     encode_prompts,
     load_config,
+    make_run_ledger,
     setup_mesh,
     enable_compile_cache,
 )
@@ -121,10 +122,6 @@ def main(
 ) -> str:
     del unused
     enable_compile_cache()
-    if not program_analysis:
-        os.environ["VIDEOP2P_OBS_NO_ANALYSIS"] = "1"
-    if latency:
-        os.environ["VIDEOP2P_OBS_LATENCY"] = "1"
     n_frames = int(train_data.get("n_sample_frames", 8))
     output_dir = output_dir + dependent_suffix(
         dependent=dependent, decay_rate=decay_rate, window_size=window_size,
@@ -138,21 +135,17 @@ def main(
                   f, indent=2, default=str)
 
     # unified run record (videop2p_tpu/obs): phases, compile events, train
-    # metrics and telemetry land in one JSONL stream, line-flushed
-    run_ledger = None
-    if telemetry or ledger or device_telemetry or latency or trace_analysis:
-        from videop2p_tpu.obs import RunLedger
-
-        run_ledger = RunLedger(
-            ledger or os.path.join(output_dir, "run_ledger.jsonl"),
-            mesh=mesh,
-            meta={"cli": "run_tuning", "max_train_steps": max_train_steps,
-                  "telemetry": bool(telemetry),
-                  "device_telemetry": bool(device_telemetry),
-                  "latency": bool(latency),
-                  "trace_analysis": bool(trace_analysis)},
-            latency=latency,
-        ).activate()
+    # metrics and telemetry land in one JSONL stream, line-flushed. The
+    # flags→ledger wiring is shared with run_videop2p and the serving
+    # engine (cli/common.make_run_ledger).
+    run_ledger = make_run_ledger(
+        os.path.join(output_dir, "run_ledger.jsonl"),
+        ledger=ledger, mesh=mesh,
+        meta={"cli": "run_tuning", "max_train_steps": max_train_steps},
+        telemetry=telemetry, device_telemetry=device_telemetry,
+        latency=latency, trace_analysis=trace_analysis,
+        program_analysis=program_analysis,
+    )
 
     sampler = None
     if dependent:

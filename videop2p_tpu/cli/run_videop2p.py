@@ -27,18 +27,15 @@ from videop2p_tpu.cli.common import (
     add_dependent_args,
     add_null_text_args,
     add_obs_args,
-    build_models,
-    encode_prompts,
     load_config,
+    make_run_ledger,
     resolve_pipeline_dir,
-    setup_mesh,
     enable_compile_cache,
 )
-from videop2p_tpu.control import make_controller
 from videop2p_tpu.core import DependentNoiseSampler
 from videop2p_tpu.obs import instrumented_jit, program_label
 from videop2p_tpu.data import load_frame_sequence
-from videop2p_tpu.models import decode_video, encode_video
+from videop2p_tpu.models import decode_video
 from videop2p_tpu.pipelines import (
     ddim_inversion,
     edit_sample,
@@ -257,6 +254,11 @@ def main(
     # of the same clip skips DDIM inversion and null-text entirely (the
     # reference's commented-out intent, run_videop2p.py:663-673)
     reuse_inversion: bool = True,
+    # shared content-addressed root for those persisted products
+    # (serve/store.py disk layer): sweeps and repeat invocations across
+    # DIFFERENT output dirs amortize one inversion per clip. Default (None)
+    # keeps the per-results-dir layout.
+    inv_store: Optional[str] = None,
     # observability (videop2p_tpu/obs): in-program telemetry riding the
     # fused scans + a JSONL run ledger (phases, compile events, memory)
     telemetry: bool = False,
@@ -321,29 +323,19 @@ def main(
 
     # unified run record: every phase_timer region, XLA compile, decoded
     # telemetry summary and memory snapshot below lands in ONE JSONL stream
-    # (events are line-flushed, so a killed run keeps what it measured)
-    run_ledger = None
-    if (telemetry or ledger or attn_maps or quality or report
-            or device_telemetry or latency or trace_analysis):
-        from videop2p_tpu import obs
-
-        run_ledger = obs.RunLedger(
-            ledger or os.path.join(output_folder, "run_ledger.jsonl"),
-            mesh=mesh,
-            meta={"cli": "run_videop2p", "fast": fast, "save_name": save_name,
-                  "prompt": prompt, "prompts": list(prompts),
-                  "telemetry": bool(telemetry),
-                  "attn_maps": bool(attn_maps), "quality": bool(quality),
-                  "device_telemetry": bool(device_telemetry),
-                  "latency": bool(latency),
-                  "trace_analysis": bool(trace_analysis),
-                  "null_text_precision": null_text_precision},
-            latency=latency,
-        ).activate()
-    if latency:
-        # pipeline-internal jits (the fused null-text cache) check the
-        # env, not the wrapper — set it so their dispatches are timed too
-        os.environ["VIDEOP2P_OBS_LATENCY"] = "1"
+    # (events are line-flushed, so a killed run keeps what it measured).
+    # The flags→ledger wiring is shared with run_tuning and the serving
+    # engine (cli/common.make_run_ledger).
+    run_ledger = make_run_ledger(
+        os.path.join(output_folder, "run_ledger.jsonl"),
+        ledger=ledger, mesh=mesh,
+        meta={"cli": "run_videop2p", "fast": fast, "save_name": save_name,
+              "prompt": prompt, "prompts": list(prompts),
+              "null_text_precision": null_text_precision},
+        telemetry=telemetry, attn_maps=attn_maps, quality=quality,
+        report=report, device_telemetry=device_telemetry, latency=latency,
+        trace_analysis=trace_analysis,
+    )
 
     def maybe_trace(window_name: str):
         """--trace_analysis: a mined jax.profiler capture around the
@@ -362,28 +354,26 @@ def main(
             ar_coeff=ar_coeff,
         )
 
-    # mixed_precision sets the model compute dtype (the reference keeps the
-    # Stage-2 UNet fp32, run_videop2p.py:111-113 — the fp32 default here
-    # matches that); scheduler/latent math stays fp32 in every mode, which
-    # is what carries inversion fidelity and the cached replay's exactness
-    dtype = {"fp16": jnp.bfloat16, "bf16": jnp.bfloat16, "fp32": jnp.float32,
-             "no": jnp.float32}[mixed_precision]
-    bundle = build_models(
-        pretrained_model_path, dtype=dtype,
-        # single-chip: "auto" → the fused Pallas kernel on TPU (measured
-        # 19.6 s → 17.0 s fast-edit e2e vs dense, round-3 A/B; memory-bounded
-        # like chunked). With a frame-sharded mesh, setup_mesh overrides the
-        # seam with the shard_map wrapper (fused per shard); "chunked" here
-        # only covers the tensor-parallel-only mesh where GSPMD partitions
-        # the plain einsum itself.
-        frame_attention="chunked" if mesh else "auto",
-        tiny=tiny,
-        seed=seed,
-        # full mode differentiates through the UNet (null-text optimization);
-        # per-block remat keeps that backward inside one chip's HBM
+    # model assembly, scheduler and the shared instrumented programs now
+    # come from ONE ProgramSet (serve/programs.py) — the same object the
+    # serving engine holds warm, so the program this CLI dispatches IS the
+    # program the server batches. mixed_precision sets the model compute
+    # dtype (the reference keeps the Stage-2 UNet fp32 — the fp32 default
+    # here matches that); scheduler/latent math stays fp32 in every mode,
+    # which is what carries inversion fidelity and the cached replay's
+    # exactness. Full mode differentiates through the UNet (null-text
+    # optimization); per-block remat keeps that backward inside one chip's
+    # HBM (gradient_checkpointing=not fast).
+    from videop2p_tpu.serve.programs import ProgramSet, ProgramSpec
+
+    program_set = ProgramSet(ProgramSpec(
+        checkpoint=pretrained_model_path, width=width, video_len=video_len,
+        steps=NUM_DDIM_STEPS, guidance_scale=GUIDANCE_SCALE, tiny=tiny,
+        mixed_precision=mixed_precision, seed=seed, mesh=mesh,
         gradient_checkpointing=not fast,
-    )
-    device_mesh = setup_mesh(bundle, mesh, video_len) if mesh else None
+    ))
+    bundle, dtype = program_set.bundle, program_set.dtype
+    device_mesh = program_set.mesh
 
     # the per-device probe needs a mesh to shard_map over; single-device
     # runs have no replicas to diverge, so the flag degrades to a note
@@ -399,26 +389,21 @@ def main(
             print("[p2p] --device_telemetry needs --mesh — single-device "
                   "runs have no replicas to probe; flag ignored")
 
-    unet_fn = make_unet_fn(bundle.unet)
+    unet_fn = program_set.unet_fn
     params = bundle.unet_params
     # the tuned pipeline's own scheduler config (incl. the steps_offset: 1 the
     # Stage-1 export writes), not hardcoded SD defaults (run_videop2p.py:101-114)
-    sched = bundle.make_scheduler()
+    sched = program_set.scheduler
     key = jax.random.key(seed)
 
     # ---- load + encode the video ----------------------------------------
     frames = load_frame_sequence(image_path, size=width, num_frames=video_len)
-    video = jnp.asarray(frames, jnp.float32)[None] / 127.5 - 1.0  # (1,F,H,W,3)
+    video = program_set.frames_to_video(frames)  # (1,F,H,W,3) in [-1,1]
     with phase_timer("vae_encode"):
         # posterior mean, not a sample — inversion fidelity
         # (image2latent_video, run_videop2p.py:530-537); one jitted dispatch
-        latents = instrumented_jit(
-            lambda vp, vid, k: encode_video(
-                bundle.vae, vp, vid.astype(dtype), k, sample=False
-            ).astype(jnp.float32),
-            program="vae_encode",
-        )(bundle.vae_params, video, key)
-        latents = jax.block_until_ready(latents)
+        # through the shared instrumented vae_encode program
+        latents = jax.block_until_ready(program_set.encode(video, key))
     if device_mesh is not None:
         from videop2p_tpu.parallel import latent_sharding
 
@@ -426,9 +411,9 @@ def main(
         # sequence-parallel with XLA-inserted collectives over ICI
         latents = jax.device_put(latents, latent_sharding(device_mesh))
 
-    cond_src = encode_prompts(bundle, [prompt])
-    cond_all = encode_prompts(bundle, list(prompts))
-    uncond = encode_prompts(bundle, [""])[0]
+    cond_src = program_set.encode_prompts([prompt])
+    cond_all = program_set.encode_prompts(list(prompts))
+    uncond = program_set.encode_prompts([""])[0]
     if multi:
         # per-frame conditioning: repeat each prompt embedding across frames
         # (the reference's `repeat(text_embeddings, 'b n c -> (b f) n c')`,
@@ -437,21 +422,16 @@ def main(
         cond_all = jnp.repeat(cond_all[:, None], video_len, axis=1)
 
     # ---- controller (host-side; needed before inversion for the cached-
-    # source capture windows) ---------------------------------------------
-    blend_words = None
-    if blend_word:
-        # the config's 2-list becomes ((src_words,), (edit_words,))
-        # (run_videop2p.py:87-88)
-        blend_words = ((blend_word[0],), (blend_word[1],))
-    ctx = make_controller(
+    # source capture windows) — shared construction with the serving
+    # engine (the config's blend_word 2-list becomes ((src,), (edit,)),
+    # run_videop2p.py:87-88)
+    ctx = program_set.controller(
         list(prompts),
-        bundle.tokenizer,
-        num_steps=NUM_DDIM_STEPS,
-        is_replace_controller=bool(is_word_swap),
+        is_word_swap=bool(is_word_swap),
         cross_replace_steps=cross_replace_steps,
         self_replace_steps=self_replace_steps,
-        blend_words=blend_words,
-        equalizer_params=dict(eq_params) if eq_params else None,
+        blend_word=blend_word,
+        eq_params=eq_params,
         mask_th=MASK_TH,
     )
 
@@ -468,12 +448,19 @@ def main(
     # different controller base maps) — identical commands must produce
     # identical results. The trajectory is still SAVED by cached-mode runs
     # so a later full-mode run of the same clip skips its inversion.
+    from videop2p_tpu.serve.store import (
+        load_persisted_inversion,
+        save_persisted_inversion,
+    )
     from videop2p_tpu.utils.inv_cache import (
         content_fingerprint,
         inversion_cache_key,
-        load_inversion,
-        save_inversion,
     )
+
+    # the disk layer's root: a shared --inv_store amortizes one inversion
+    # across sweeps / output dirs (keys are content-addressed, so sharing
+    # is always safe); default keeps the per-results-dir layout
+    store_root = inv_store or output_folder
 
     inv_key = inversion_cache_key(
         image_path=os.path.abspath(image_path), prompt=prompt,
@@ -552,8 +539,8 @@ def main(
         "_mixed" if null_text_precision == "mixed" else ""
     )
     reused = (
-        load_inversion(
-            output_folder, inv_key, want_null=not fast,
+        load_persisted_inversion(
+            store_root, inv_key, want_null=not fast,
             null_tag=null_tag,
         )
         if reuse_inversion and not use_cached
@@ -633,8 +620,8 @@ def main(
         print(f"[p2p] cached invert+edit+decode done in "
               f"{time.perf_counter() - t0:.1f}s")
         if reuse_inversion:
-            save_inversion(
-                output_folder, inv_key, np.asarray(traj),
+            save_persisted_inversion(
+                store_root, inv_key, np.asarray(traj),
                 meta={"image_path": image_path, "prompt": prompt,
                       "steps": NUM_DDIM_STEPS, "width": width,
                       "video_len": video_len, "fast": fast},
@@ -668,8 +655,8 @@ def main(
                 traj = inv
             x_t = jax.block_until_ready(traj[-1])
         if reuse_inversion:
-            save_inversion(
-                output_folder, inv_key, np.asarray(traj),
+            save_persisted_inversion(
+                store_root, inv_key, np.asarray(traj),
                 meta={"image_path": image_path, "prompt": prompt,
                       "steps": NUM_DDIM_STEPS, "width": width,
                       "video_len": video_len, "fast": fast},
@@ -752,8 +739,8 @@ def main(
         if reuse_inversion:
             # trajectory.npy was written after inversion — only the null
             # embeddings are new here
-            save_inversion(
-                output_folder, inv_key, None,
+            save_persisted_inversion(
+                store_root, inv_key, None,
                 np.asarray(null_embeddings), null_tag=null_tag,
             )
         jax.clear_caches()
@@ -810,17 +797,9 @@ def main(
         # fp32 full scale the two do not fit the chip together
         jax.clear_caches()
         with phase_timer("vae_decode"):
-            # one jitted dispatch, rescale included
-            videos = instrumented_jit(
-                lambda vp, x: (
-                    decode_video(
-                        bundle.vae, vp, x.astype(dtype), sequential=True
-                    ).astype(jnp.float32)
-                    + 1
-                ) / 2,
-                program="vae_decode",
-            )(bundle.vae_params, out)
-            videos = np.asarray(jax.device_get(videos))
+            # one jitted dispatch, rescale included — the shared
+            # instrumented vae_decode program (serve/programs.py)
+            videos = np.asarray(jax.device_get(program_set.decode(out)))
 
     # stream 0 = inversion reconstruction, stream 1 = edit
     # (run_videop2p.py:688-701; duration 250 ms/frame = 4 fps)
@@ -876,6 +855,12 @@ if __name__ == "__main__":
     parser.add_argument("--no_reuse_inversion", action="store_true",
                         help="do not persist/reuse inversion products "
                              "(trajectory + null embeddings) across runs")
+    parser.add_argument("--inv_store", type=str, default=None,
+                        help="shared content-addressed root for persisted "
+                             "inversion products (serve/store.py disk "
+                             "layer) — sweeps amortize one inversion per "
+                             "clip across cells; default keeps the "
+                             "per-results-dir layout")
     parser.add_argument("--mixed_precision", type=str, default=None,
                         choices=["fp32", "no", "fp16", "bf16"],
                         help="model compute dtype (default fp32 = the "
@@ -917,6 +902,7 @@ if __name__ == "__main__":
         multi=args.multi,
         cached_source=not args.live_source,
         reuse_inversion=not args.no_reuse_inversion,
+        inv_store=args.inv_store,
         telemetry=args.telemetry,
         ledger=args.ledger,
         program_analysis=not args.no_program_analysis,

@@ -1,0 +1,111 @@
+"""Edit-serving entry point: a persistent engine behind a JSON HTTP API.
+
+Holds warm compiled programs (one :class:`~videop2p_tpu.serve.programs.
+ProgramSet` per checkpoint/geometry/steps spec), a device-resident
+inversion store, and a micro-batcher — so repeat and concurrent edits stop
+paying per-invocation compiles and per-edit inversions (ROADMAP item 1).
+See ``docs/SERVING.md`` for the architecture and the knob table.
+
+Run:  python -m videop2p_tpu.cli.serve --checkpoint <pipeline-dir> --port 8000
+      python -m videop2p_tpu.cli.serve --tiny --steps 4 --video_len 2   # smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from videop2p_tpu.cli.common import enable_compile_cache
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--checkpoint", type=str, default=None,
+                    help="tuned pipeline dir (random-init smoke when absent)")
+    ap.add_argument("--width", type=int, default=512)
+    ap.add_argument("--video_len", type=int, default=8)
+    ap.add_argument("--steps", type=int, default=50)
+    ap.add_argument("--guidance_scale", type=float, default=7.5)
+    ap.add_argument("--tiny", action="store_true",
+                    help="random-init tiny models (weightless smoke mode)")
+    ap.add_argument("--mixed_precision", type=str, default="fp32",
+                    choices=["fp32", "no", "fp16", "bf16"])
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--mesh", type=str, default=None,
+                    help="dp,sp,tp — sp/tp shard the model; dp>1 is the "
+                         "serving data axis batched dispatches shard over")
+    ap.add_argument("--host", type=str, default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--out_dir", type=str, default="serve_out",
+                    help="per-request artifact dir (GIFs, the serve ledger)")
+    ap.add_argument("--store_budget_gb", type=float, default=4.0,
+                    help="device-resident inversion-store byte budget (LRU)")
+    ap.add_argument("--inv_store", type=str, default=None,
+                    help="disk write-through root for inversion trajectories "
+                         "(shared with the CLIs' --inv_store)")
+    ap.add_argument("--max_batch", type=int, default=4,
+                    help="micro-batch cap per dispatch")
+    ap.add_argument("--max_wait_ms", type=float, default=50.0,
+                    help="admit-window deadline before dispatching a partial "
+                         "batch")
+    ap.add_argument("--batch_dispatch", type=str, default="scan",
+                    choices=["scan", "vmap"],
+                    help="scan: one dispatch, per-request math bit-exact vs "
+                         "singleton; vmap: vectorized + data-mesh sharded")
+    ap.add_argument("--ledger", type=str, default=None,
+                    help="serve ledger path (default <out_dir>/serve_ledger"
+                         ".jsonl) — live /metrics reads its reservoirs")
+    ap.add_argument("--no_warm", action="store_true",
+                    help="skip the startup compile warm-up")
+    ap.add_argument("--warm_prompts", type=str, nargs=2,
+                    default=["a video", "an edited video"],
+                    help="source/edit prompt pair whose controller structure "
+                         "the warm-up compiles for")
+    return ap
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    enable_compile_cache()
+    from videop2p_tpu.parallel import initialize_distributed
+
+    initialize_distributed()
+    from videop2p_tpu.serve import EditEngine, ProgramSpec
+    from videop2p_tpu.serve.http import make_server
+
+    spec = ProgramSpec(
+        checkpoint=args.checkpoint, width=args.width,
+        video_len=args.video_len, steps=args.steps,
+        guidance_scale=args.guidance_scale, tiny=args.tiny,
+        mixed_precision=args.mixed_precision, seed=args.seed, mesh=args.mesh,
+    )
+    engine = EditEngine(
+        spec,
+        out_dir=args.out_dir,
+        store_budget_bytes=int(args.store_budget_gb * (1 << 30)),
+        persist_dir=args.inv_store,
+        max_batch=args.max_batch,
+        max_wait_s=args.max_wait_ms / 1000.0,
+        batch_dispatch=args.batch_dispatch,
+        ledger_path=args.ledger,
+    )
+    if not args.no_warm:
+        print(f"[serve] warming programs (spec {engine.spec.fingerprint()})...")
+        info = engine.warm(tuple(args.warm_prompts),
+                           batch_sizes=(min(2, args.max_batch),))
+        print(f"[serve] warm in {info['seconds']}s "
+              f"(batch sizes {info['batch_sizes']})")
+    server = make_server(engine, host=args.host, port=args.port)
+    print(f"[serve] listening on {server.url}  "
+          f"(ledger: {engine.ledger.path})")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        print("[serve] shutting down")
+    finally:
+        server.httpd.server_close()
+        engine.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -17,7 +17,7 @@ import argparse
 import itertools
 import subprocess
 import sys
-from typing import List
+from typing import List, Optional
 
 
 def cell_commands(
@@ -34,8 +34,16 @@ def cell_commands(
     fast: bool,
     dependent_p2p: bool,
     extra: List[str],
+    inv_store: Optional[str] = None,
 ) -> List[List[str]]:
-    """The two subprocess argvs for one grid cell (run_rabbit.py:36-56)."""
+    """The two subprocess argvs for one grid cell (run_rabbit.py:36-56).
+
+    ``inv_store`` routes every cell's Stage-2 inversion persistence through
+    ONE shared content-addressed root (the ``serve/store.py`` disk layer):
+    cells whose inversion determinants agree (same clip, checkpoint, steps,
+    dependent settings) reuse one DDIM inversion instead of re-walking it
+    per scenario; cells that differ miss by key construction — sharing is
+    always safe."""
     common = [
         "--dependent",
         "--decay_rate", str(decay_rate),
@@ -51,6 +59,8 @@ def cell_commands(
             "--config", tune_config] + common + extra
     p2p = [sys.executable, "-m", "videop2p_tpu.cli.run_videop2p",
            "--config", p2p_config] + common + extra
+    if inv_store:
+        p2p += ["--inv_store", inv_store]
     if fast:
         p2p.append("--fast")
     if dependent_p2p:
@@ -75,6 +85,14 @@ def main(argv=None) -> int:
     ap.add_argument("--dependent_p2p", action="store_true")
     ap.add_argument("--skip_tune", action="store_true",
                     help="reuse existing Stage-1 checkpoints, only re-edit")
+    ap.add_argument("--inv_store", type=str, default="inv_store",
+                    help="shared inversion-store root every cell's Stage-2 "
+                         "run persists/reuses DDIM inversions through "
+                         "(serve/store.py disk layer; content-addressed "
+                         "keys make sharing always safe)")
+    ap.add_argument("--no_inv_store", action="store_true",
+                    help="per-cell inversion persistence only (the "
+                         "pre-store layout under each results dir)")
     ap.add_argument("--dry_run", action="store_true", help="print commands only")
     # everything the sweep doesn't recognize is forwarded to both stages in
     # original order (flag-style extras like `--tiny` or `--width 256` work
@@ -96,6 +114,7 @@ def main(argv=None) -> int:
             ar_coeff=args.ar_coeff, num_frames=args.num_frames,
             fast=args.fast, dependent_p2p=args.dependent_p2p,
             extra=list(args.extra),
+            inv_store=None if args.no_inv_store else args.inv_store,
         )
         if args.skip_tune:
             cmds = cmds[1:]

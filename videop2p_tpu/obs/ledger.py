@@ -285,20 +285,29 @@ class RunLedger:
                 res = self._timing[program] = LatencyReservoir()
         res.add(dispatch_s, blocked_s)
 
-    def flush_execute_timing(self) -> None:
-        """One ``execute_timing`` event per program with recorded
-        dispatches (count, dispatch/blocked p50/p95/p99/max, the
-        dispatch-vs-blocked split). Reservoirs keep accumulating — a
-        later flush supersedes (extract_run keeps the last event)."""
+    def execute_timing_summary(self) -> Dict[str, Dict[str, float]]:
+        """Live per-program reservoir summaries WITHOUT writing events —
+        what a serving ``/metrics`` endpoint polls between flushes.
+        Programs with no recorded dispatches are omitted."""
         with self._timing_lock:
             items = sorted(self._timing.items())
+        out: Dict[str, Dict[str, float]] = {}
         for program, res in items:
             try:
                 summary = res.summary()
             except Exception:  # noqa: BLE001 — obs never kills a run
                 continue
             if summary:
-                self.event("execute_timing", program=program, **summary)
+                out[program] = summary
+        return out
+
+    def flush_execute_timing(self) -> None:
+        """One ``execute_timing`` event per program with recorded
+        dispatches (count, dispatch/blocked p50/p95/p99/max, the
+        dispatch-vs-blocked split). Reservoirs keep accumulating — a
+        later flush supersedes (extract_run keeps the last event)."""
+        for program, summary in self.execute_timing_summary().items():
+            self.event("execute_timing", program=program, **summary)
 
     def _on_compile(self, seconds: float, program: Optional[str]) -> None:
         self.compile_seconds.append(float(seconds))

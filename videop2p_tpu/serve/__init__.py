@@ -1,0 +1,65 @@
+"""Persistent multi-tenant edit serving (ISSUE 7 — ROADMAP item 1).
+
+The one-shot CLIs pay full program compilation per invocation and repeat
+DDIM inversions per edit of the same clip. This package keeps both warm:
+
+  * :mod:`videop2p_tpu.serve.programs` — :class:`ProgramSet`: model
+    assembly + scheduler + the instrumented jitted programs (VAE encode,
+    capture-inversion, cached-source edit + decode), built once per
+    (checkpoint, geometry, steps) :class:`ProgramSpec` key. Controller and
+    capture pytrees are traced jit ARGUMENTS, so requests differing only
+    in prompts/clips share compiled executables. :class:`ProgramCache` is
+    the multi-tenant layer.
+  * :mod:`videop2p_tpu.serve.store` — :class:`InversionStore`: a
+    byte-budgeted device-resident LRU of inversion products keyed
+    content-addressed (``utils/inv_cache``), with optional disk
+    write-through of trajectories shared with the CLIs (``--inv_store``).
+  * :mod:`videop2p_tpu.serve.batching` — deterministic grouping/padding of
+    compatible concurrent requests into one dispatch (bit-exact ``scan``
+    mode; data-mesh-sharded ``vmap`` mode).
+  * :mod:`videop2p_tpu.serve.engine` — :class:`EditEngine`: the request
+    lifecycle (admit → resolve → batch → dispatch → decode) on one worker
+    thread, with the run ledger as live SLO telemetry.
+  * :mod:`videop2p_tpu.serve.http` / :mod:`videop2p_tpu.serve.client` —
+    the stdlib JSON API (``cli/serve.py`` is the entry point) and its
+    urllib client (the UI's engine-backed path; ``tools/serve_loadgen.py``).
+
+Import contract: stdlib + numpy + jax (+ the package itself) only — the
+same guard as ``obs/`` (tests/test_bench_guard.py walks this package).
+"""
+
+from videop2p_tpu.serve.batching import (
+    Batch,
+    bucket_size,
+    compat_key,
+    plan_batches,
+    stack_items,
+    unstack_outputs,
+)
+from videop2p_tpu.serve.client import EngineClient, engine_available
+from videop2p_tpu.serve.engine import EditEngine, EditRequest
+from videop2p_tpu.serve.programs import ProgramCache, ProgramSet, ProgramSpec
+from videop2p_tpu.serve.store import (
+    InversionStore,
+    load_persisted_inversion,
+    save_persisted_inversion,
+)
+
+__all__ = [
+    "Batch",
+    "bucket_size",
+    "compat_key",
+    "plan_batches",
+    "stack_items",
+    "unstack_outputs",
+    "EngineClient",
+    "engine_available",
+    "EditEngine",
+    "EditRequest",
+    "ProgramCache",
+    "ProgramSet",
+    "ProgramSpec",
+    "InversionStore",
+    "load_persisted_inversion",
+    "save_persisted_inversion",
+]

@@ -1,0 +1,100 @@
+"""Stdlib HTTP client for the edit-serving engine.
+
+The thin urllib counterpart of :mod:`videop2p_tpu.serve.http` — the demo
+UI's engine-backed path, ``tools/serve_loadgen.py`` and scripts talk to a
+running ``cli/serve.py`` through this. No third-party HTTP stack; the
+import-guard test walks this package.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, Optional
+
+__all__ = ["EngineClient", "engine_available"]
+
+
+class EngineClient:
+    """JSON client over the ``/v1/edits`` + ``/healthz`` + ``/metrics`` API."""
+
+    def __init__(self, base_url: str, *, timeout_s: float = 10.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = float(timeout_s)
+
+    # ---- plumbing --------------------------------------------------------
+
+    def _request(self, path: str, payload: Optional[Dict] = None,
+                 timeout_s: Optional[float] = None) -> Dict[str, Any]:
+        data = None
+        headers = {}
+        if payload is not None:
+            data = json.dumps(payload).encode()
+            headers["Content-Type"] = "application/json"
+        req = urllib.request.Request(
+            self.base_url + path, data=data, headers=headers
+        )
+        try:
+            with urllib.request.urlopen(
+                req, timeout=timeout_s or self.timeout_s
+            ) as resp:
+                return json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            try:
+                detail = json.loads(e.read() or b"{}").get("error", "")
+            except ValueError:
+                detail = ""
+            raise RuntimeError(
+                f"{path} failed with HTTP {e.code}: {detail or e.reason}"
+            ) from e
+
+    # ---- API -------------------------------------------------------------
+
+    def healthz(self) -> Dict[str, Any]:
+        return self._request("/healthz")
+
+    def metrics(self) -> Dict[str, Any]:
+        return self._request("/metrics")
+
+    def submit(self, request: Dict[str, Any]) -> str:
+        """Submit an edit request dict (EditRequest fields); returns the id."""
+        return self._request("/v1/edits", payload=request)["id"]
+
+    def poll(self, rid: str) -> Dict[str, Any]:
+        return self._request(f"/v1/edits/{rid}")
+
+    def result(self, rid: str, *, wait_s: float = 0.0) -> Dict[str, Any]:
+        """Server-side wait (bounded per call by the client timeout)."""
+        return self._request(
+            f"/v1/edits/{rid}/result?wait_s={float(wait_s)}",
+            timeout_s=max(self.timeout_s, float(wait_s) + 5.0),
+        )
+
+    def wait(self, rid: str, *, timeout_s: float = 600.0,
+             poll_interval_s: float = 0.25) -> Dict[str, Any]:
+        """Client-side wait loop until the record is terminal; raises
+        TimeoutError when the deadline passes first."""
+        deadline = time.perf_counter() + float(timeout_s)
+        while True:
+            rec = self.poll(rid)
+            if rec.get("status") in ("done", "error"):
+                return rec
+            if time.perf_counter() >= deadline:
+                raise TimeoutError(
+                    f"request {rid} still {rec.get('status')!r} after "
+                    f"{timeout_s:.0f}s"
+                )
+            time.sleep(poll_interval_s)
+
+
+def engine_available(base_url: Optional[str], *, timeout_s: float = 2.0) -> bool:
+    """True when a healthy engine answers at ``base_url`` — the UI's
+    engine-vs-subprocess routing check. Never raises."""
+    if not base_url:
+        return False
+    try:
+        return bool(EngineClient(base_url, timeout_s=timeout_s).healthz().get("ok"))
+    except Exception:  # noqa: BLE001 — availability probes must not throw
+        return False

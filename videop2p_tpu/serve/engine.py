@@ -1,0 +1,484 @@
+"""EditEngine: the persistent in-process edit-serving core.
+
+Request lifecycle (one worker thread owns every device dispatch, so JAX
+program order is deterministic and the HTTP layer never touches devices):
+
+  admit → resolve (controller + content-addressed inversion-store lookup;
+  a miss runs VAE encode + capture-inversion ONCE per clip and stores the
+  products device-resident) → batch (compatible concurrent requests group
+  into one dispatch, :mod:`videop2p_tpu.serve.batching`) → dispatch (the
+  warm ``serve_edit`` program: cached-source controlled edit + VAE decode)
+  → artifacts (GIFs) + per-request verdicts (``src_err``, compile-event
+  delta, store hit).
+
+Observability is the live run ledger: the engine owns an activated
+:class:`~videop2p_tpu.obs.RunLedger` with execute timing ON, so every
+program dispatch lands in the per-program latency reservoirs
+(:mod:`videop2p_tpu.obs.timing`) and every compile is attributed — the
+``/metrics`` endpoint reads those reservoirs directly (p50/p95/p99 per
+program and per request-phase) and the ledger file is diffable with
+``tools/obs_diff.py`` like any other run's.
+
+Stdlib+numpy+jax only — the import-guard test walks this package.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from videop2p_tpu.serve.batching import (
+    compat_key,
+    plan_batches,
+    stack_items,
+    unstack_outputs,
+)
+from videop2p_tpu.serve.programs import ProgramSet, ProgramSpec
+from videop2p_tpu.serve.store import InversionStore
+
+__all__ = ["EditRequest", "EditEngine"]
+
+_REQUEST_FIELDS = (
+    "image_path", "prompt", "prompts", "save_name", "is_word_swap",
+    "blend_word", "eq_params", "cross_replace_steps", "self_replace_steps",
+    "seed",
+)
+
+
+@dataclass
+class EditRequest:
+    """One edit of one clip — the JSON surface of the HTTP API.
+
+    ``frames`` (host array, (F, H, W, 3) uint8) may replace ``image_path``
+    for in-process callers; it never crosses the JSON boundary.
+    """
+
+    image_path: str = ""
+    prompt: str = ""
+    prompts: Sequence[str] = field(default_factory=list)
+    save_name: str = "edit"
+    is_word_swap: bool = False
+    blend_word: Optional[Sequence[str]] = None
+    eq_params: Optional[Dict] = None
+    cross_replace_steps: float = 0.2
+    self_replace_steps: float = 0.5
+    seed: int = 0
+    frames: Optional[np.ndarray] = None
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "EditRequest":
+        unknown = set(d) - set(_REQUEST_FIELDS)
+        if unknown:
+            raise ValueError(f"unknown request field(s): {sorted(unknown)}")
+        return cls(**d)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {k: getattr(self, k) for k in _REQUEST_FIELDS}
+
+    def validate(self) -> None:
+        if not self.prompt:
+            raise ValueError("request needs a source 'prompt'")
+        if len(list(self.prompts)) < 2:
+            raise ValueError(
+                "request needs 'prompts' = [source, edit, ...] (>= 2 entries)"
+            )
+        if list(self.prompts)[0] != self.prompt:
+            raise ValueError("prompts[0] must equal the source prompt")
+        if self.frames is None and not self.image_path:
+            raise ValueError("request needs 'image_path' (or in-process frames)")
+
+
+@dataclass
+class _Prepared:
+    """A resolved request, ready to batch: the device argument tree plus
+    its batching-compatibility key."""
+
+    rid: str
+    args: Tuple  # (cached, cond_all, uncond, ctx, anchor)
+    compat: str
+
+
+class EditEngine:
+    """Persistent multi-tenant edit engine over one :class:`ProgramSet`."""
+
+    def __init__(
+        self,
+        spec: ProgramSpec,
+        *,
+        out_dir: str,
+        store_budget_bytes: int = 4 << 30,
+        persist_dir: Optional[str] = None,
+        max_batch: int = 4,
+        max_wait_s: float = 0.05,
+        batch_dispatch: str = "scan",
+        ledger_path: Optional[str] = None,
+        keep_videos: bool = False,
+        programs: Optional[ProgramSet] = None,
+    ):
+        from videop2p_tpu.cli.common import make_run_ledger
+
+        self.out_dir = out_dir
+        os.makedirs(out_dir, exist_ok=True)
+        self.max_batch = int(max_batch)
+        self.max_wait_s = float(max_wait_s)
+        self.batch_dispatch = batch_dispatch
+        self.keep_videos = bool(keep_videos)
+        self.ledger = make_run_ledger(
+            ledger_path or os.path.join(out_dir, "serve_ledger.jsonl"),
+            enable=True, latency=True, set_latency_env=False,
+            meta={"cli": "serve", "spec": dict(spec.resolved().__dict__)},
+            mesh=spec.mesh,
+        )
+        self.programs = programs if programs is not None else ProgramSet(spec)
+        self.spec = self.programs.spec
+        self.store = InversionStore(store_budget_bytes, persist_dir=persist_dir)
+        self._spec_fp = self.spec.fingerprint()
+        self._requests: Dict[str, Dict[str, Any]] = {}
+        self._videos: Dict[str, np.ndarray] = {}
+        self._req_lock = threading.Lock()
+        self._queue: "queue.Queue" = queue.Queue()
+        self._done = threading.Event()
+        self._closed = False
+        self.started = time.perf_counter()
+        self._worker = threading.Thread(
+            target=self._worker_loop, name="edit-engine", daemon=True
+        )
+        self._worker.start()
+
+    # ---- public API ------------------------------------------------------
+
+    def warm(self, prompts: Sequence[str] = ("a video", "an edited video"),
+             *, controller_kwargs: Optional[Dict] = None,
+             batch_sizes: Sequence[int] = (2,)) -> Dict[str, Any]:
+        """Compile the request path on zeros (see
+        :meth:`videop2p_tpu.serve.programs.ProgramSet.warm`); the summary
+        lands in the ledger and ``/healthz``."""
+        info = self.programs.warm(
+            prompts, controller_kwargs=controller_kwargs,
+            batch_sizes=batch_sizes, dispatch=self.batch_dispatch,
+        )
+        self.ledger.event("serve_warm", **info)
+        return info
+
+    def submit(self, request: EditRequest) -> str:
+        """Enqueue one request; returns its id immediately."""
+        if self._closed:
+            raise RuntimeError("engine is closed")
+        request.validate()
+        rid = uuid.uuid4().hex[:12]
+        rec = {
+            "id": rid,
+            "status": "queued",
+            "submitted_s": time.perf_counter(),
+            "request": {k: v for k, v in request.to_dict().items()
+                        if k != "frames"},
+            "compile_events_before": len(self.ledger.compile_seconds),
+        }
+        with self._req_lock:
+            self._requests[rid] = rec
+        self._queue.put((rid, request))
+        return rid
+
+    def poll(self, rid: str) -> Dict[str, Any]:
+        """JSON-safe snapshot of one request's record."""
+        with self._req_lock:
+            rec = self._requests.get(rid)
+            if rec is None:
+                raise KeyError(f"unknown request id {rid!r}")
+            return json.loads(json.dumps(rec, default=str))
+
+    def result(self, rid: str, *, wait_s: float = 0.0,
+               poll_interval_s: float = 0.02) -> Dict[str, Any]:
+        """The record once terminal; with ``wait_s`` blocks up to that long."""
+        deadline = time.perf_counter() + max(float(wait_s), 0.0)
+        while True:
+            rec = self.poll(rid)
+            if rec["status"] in ("done", "error"):
+                return rec
+            if time.perf_counter() >= deadline:
+                return rec
+            time.sleep(poll_interval_s)
+
+    def videos(self, rid: str) -> Optional[np.ndarray]:
+        """The decoded (P, F, H, W, 3) [0,1] array for in-process callers
+        (kept only with ``keep_videos=True``)."""
+        return self._videos.get(rid)
+
+    def metrics(self) -> Dict[str, Any]:
+        """The live SLO record ``/metrics`` serves: per-program and
+        per-phase latency distributions straight from the ledger's
+        reservoirs, compile-vs-execute split, store hit rates, request
+        counts and per-device HBM."""
+        with self._req_lock:
+            by_status: Dict[str, int] = {}
+            for rec in self._requests.values():
+                by_status[rec["status"]] = by_status.get(rec["status"], 0) + 1
+        timing = self.ledger.execute_timing_summary()
+        request_latency = timing.get("serve_request_e2e")
+        return {
+            "uptime_s": round(time.perf_counter() - self.started, 3),
+            "spec_fingerprint": self._spec_fp,
+            "warm": self.programs.warmed,
+            "requests": by_status,
+            "store": self.store.stats(),
+            "compile": {
+                "events": len(self.ledger.compile_seconds),
+                "total_s": round(sum(self.ledger.compile_seconds), 4),
+            },
+            "request_latency": request_latency,
+            "programs": timing,
+            "devices": self._device_memory(),
+        }
+
+    def close(self) -> None:
+        """Drain, stop the worker, flush execute timing, close the ledger."""
+        if self._closed:
+            return
+        self._closed = True
+        self._queue.put(None)
+        self._worker.join(timeout=60.0)
+        self.ledger.event("serve_shutdown", requests=len(self._requests))
+        self.ledger.close()
+
+    def __enter__(self) -> "EditEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ---- worker ----------------------------------------------------------
+
+    def _worker_loop(self) -> None:
+        while True:
+            batch = self._collect()
+            if batch is None:
+                break
+            if not batch:
+                continue
+            prepared = []
+            for rid, request in batch:
+                p = self._resolve(rid, request)
+                if p is not None:
+                    prepared.append(p)
+            for plan in plan_batches(prepared, max_batch=self.max_batch):
+                self._dispatch(plan)
+        self._done.set()
+
+    def _collect(self):
+        """One admit window: block for the first request, then keep
+        draining compatible-or-not requests until ``max_batch`` are in
+        hand or ``max_wait_s`` elapses (grouping happens after resolve —
+        an incompatible request simply lands in its own batch)."""
+        try:
+            first = self._queue.get(timeout=0.2)
+        except queue.Empty:
+            return []
+        if first is None:
+            return None
+        items = [first]
+        deadline = time.perf_counter() + self.max_wait_s
+        while len(items) < self.max_batch:
+            remaining = deadline - time.perf_counter()
+            if remaining <= 0:
+                break
+            try:
+                nxt = self._queue.get(timeout=max(remaining, 0.0))
+            except queue.Empty:
+                break
+            if nxt is None:
+                self._queue.put(None)  # re-post the sentinel for the outer loop
+                break
+            items.append(nxt)
+        return items
+
+    def _update(self, rid: str, **fields) -> Dict[str, Any]:
+        with self._req_lock:
+            rec = self._requests[rid]
+            rec.update(fields)
+            return rec
+
+    def _store_key(self, request: EditRequest, ctx) -> str:
+        """Content-addressed inversion-product identity: the program-set
+        fingerprint (checkpoint content + geometry + steps) x the clip
+        content x the source prompt x the capture plan the controller
+        implies. Anything that changes the products changes the key."""
+        import hashlib
+
+        from videop2p_tpu.pipelines.cached import capture_windows
+        from videop2p_tpu.utils.inv_cache import (
+            content_fingerprint,
+            inversion_cache_key,
+        )
+
+        if request.frames is not None:
+            clip = hashlib.sha256(
+                np.ascontiguousarray(request.frames).tobytes()
+            ).hexdigest()[:16]
+        else:
+            clip = content_fingerprint(os.path.abspath(request.image_path))
+        cross_len, self_window = capture_windows(ctx, self.spec.steps)
+        return inversion_cache_key(
+            spec=self._spec_fp, clip=clip, prompt=request.prompt,
+            seed=request.seed, cross_len=cross_len, self_window=self_window,
+            capture_blend=ctx.blend is not None,
+        )
+
+    def _resolve(self, rid: str, request: EditRequest) -> Optional[_Prepared]:
+        """Admit one request: controller, prompt encodings, store lookup,
+        and on a miss the once-per-clip encode + capture-inversion."""
+        t0 = time.perf_counter()
+        self._update(rid, status="resolving")
+        try:
+            ps = self.programs
+            ctx = ps.controller(
+                list(request.prompts),
+                is_word_swap=request.is_word_swap,
+                cross_replace_steps=request.cross_replace_steps,
+                self_replace_steps=request.self_replace_steps,
+                blend_word=request.blend_word,
+                eq_params=request.eq_params,
+            )
+            cond_all = ps.encode_prompts(list(request.prompts))
+            uncond = ps.encode_prompts([""])[0]
+            key = self._store_key(request, ctx)
+            products = self.store.get(key)
+            hit = products is not None
+            if not hit:
+                if request.frames is not None:
+                    frames = np.asarray(request.frames)
+                else:
+                    from videop2p_tpu.data import load_frame_sequence
+
+                    frames = load_frame_sequence(
+                        request.image_path, size=self.spec.width,
+                        num_frames=self.spec.video_len,
+                    )
+                _, ik = jax.random.split(jax.random.key(request.seed))
+                latents = ps.encode(
+                    ps.frames_to_video(frames), jax.random.key(request.seed)
+                )
+                traj, cached = ps.invert_capture(
+                    latents, ps.encode_prompts([request.prompt]), ctx, ik
+                )[:2]
+                products = (cached, latents)
+                self.store.put(
+                    key, products,
+                    trajectory=(np.asarray(jax.device_get(traj))
+                                if self.store.persist_dir else None),
+                    meta={"image_path": request.image_path,
+                          "prompt": request.prompt,
+                          "steps": self.spec.steps,
+                          "width": self.spec.width,
+                          "video_len": self.spec.video_len},
+                )
+            cached, anchor = products
+            args = (cached, cond_all, uncond, ctx, anchor)
+            dt = time.perf_counter() - t0
+            self.ledger.record_execute("serve_resolve", dt, dt)
+            self._update(rid, store_hit=hit, store_key=key,
+                         resolve_s=round(dt, 4))
+            return _Prepared(
+                rid=rid, args=args,
+                compat=compat_key(args, extra=(
+                    self._spec_fp, self.spec.steps, self.spec.guidance_scale,
+                    self.batch_dispatch,
+                )),
+            )
+        except Exception as e:  # noqa: BLE001 — one bad request must not kill the engine
+            self._fail(rid, f"resolve failed: {e}", t0)
+            return None
+
+    def _dispatch(self, plan) -> None:
+        """One device dispatch for a planned batch (singleton or stacked)."""
+        t0 = time.perf_counter()
+        for p in plan.items:
+            self._update(p.rid, status="running",
+                         batch_size=len(plan.items),
+                         padded_size=plan.padded_size)
+        try:
+            ps = self.programs
+            if plan.padded_size == 1:
+                videos, src_err = ps.edit_decode(*plan.items[0].args)
+                outs = [(videos, src_err)]
+            else:
+                stacked = stack_items(
+                    [p.args for p in plan.items], plan.padded_size
+                )
+                videos_b, src_err_b = ps.edit_decode_batch(
+                    stacked, plan.padded_size, dispatch=self.batch_dispatch
+                )
+                outs = unstack_outputs(
+                    (videos_b, src_err_b), len(plan.items)
+                )
+            jax.block_until_ready([o[0] for o in outs])
+            dt = time.perf_counter() - t0
+            self.ledger.record_execute("serve_dispatch", dt, dt)
+            for p, (videos, src_err) in zip(plan.items, outs):
+                self._finish(p.rid, np.asarray(jax.device_get(videos)),
+                             float(np.asarray(jax.device_get(src_err))), dt)
+        except Exception as e:  # noqa: BLE001
+            for p in plan.items:
+                self._fail(p.rid, f"dispatch failed: {e}", t0)
+
+    def _finish(self, rid: str, videos: np.ndarray, src_err: float,
+                dispatch_s: float) -> None:
+        from videop2p_tpu.utils.video_io import save_video_gif
+
+        rec = self.poll(rid)
+        req = rec["request"]
+        req_dir = os.path.join(self.out_dir, rid)
+        os.makedirs(req_dir, exist_ok=True)
+        inversion_gif = os.path.join(req_dir, "inversion.gif")
+        edit_gif = os.path.join(req_dir, f"{req.get('save_name', 'edit')}.gif")
+        save_video_gif(videos[0], inversion_gif, fps=4)
+        save_video_gif(videos[1], edit_gif, fps=4)
+        if self.keep_videos:
+            self._videos[rid] = videos
+        total = time.perf_counter() - rec["submitted_s"]
+        self.ledger.record_execute("serve_request_e2e", total, total)
+        compile_events = (len(self.ledger.compile_seconds)
+                          - rec.get("compile_events_before", 0))
+        self._update(
+            rid, status="done",
+            dispatch_s=round(dispatch_s, 4), total_s=round(total, 4),
+            src_err=src_err, compile_events=compile_events,
+            inversion_gif=inversion_gif, edit_gif=edit_gif,
+        )
+        self.ledger.event(
+            "serve_request", id=rid, total_s=round(total, 4),
+            src_err=src_err, compile_events=compile_events,
+            store_hit=self.poll(rid).get("store_hit"),
+        )
+
+    def _fail(self, rid: str, message: str, t0: float) -> None:
+        self._update(rid, status="error", error=message,
+                     total_s=round(time.perf_counter() - t0, 4))
+        self.ledger.event("serve_request_error", id=rid, error=message)
+
+    @staticmethod
+    def _device_memory() -> List[Dict[str, Any]]:
+        out = []
+        try:
+            for d in jax.local_devices():
+                try:
+                    ms = d.memory_stats() or {}
+                except Exception:  # noqa: BLE001
+                    ms = {}
+                out.append({
+                    "device": d.id,
+                    "bytes_in_use": ms.get("bytes_in_use"),
+                    "peak_bytes_in_use": ms.get("peak_bytes_in_use"),
+                    "bytes_limit": ms.get("bytes_limit"),
+                })
+        except Exception:  # noqa: BLE001
+            pass
+        return out

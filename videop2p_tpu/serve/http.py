@@ -1,0 +1,147 @@
+"""Stdlib JSON HTTP front-end for :class:`~videop2p_tpu.serve.engine.EditEngine`.
+
+Endpoints (all JSON):
+
+  * ``POST /v1/edits``           — submit an :class:`EditRequest` body →
+    ``{"id": ...}`` (202). Clips are server-local paths (``image_path``).
+  * ``GET  /v1/edits/<id>``      — poll one request's record.
+  * ``GET  /v1/edits/<id>/result?wait_s=N`` — block up to N s for a
+    terminal record.
+  * ``GET  /healthz``            — liveness + warm summary (200 always
+    once the engine exists; load balancers key on ``"ok"``).
+  * ``GET  /metrics``            — the live SLO record: per-program /
+    per-phase latency percentiles from the ledger's reservoirs,
+    compile-vs-execute split, store hit rates, per-device HBM.
+
+``ThreadingHTTPServer`` handlers only enqueue and read — every device
+dispatch stays on the engine's single worker thread. Stdlib only; the
+import-guard test walks this package.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from videop2p_tpu.serve.engine import EditEngine, EditRequest
+
+__all__ = ["EditServer", "make_server"]
+
+_EDIT_PATH = re.compile(r"^/v1/edits/([0-9a-f]+)(/result)?$")
+
+
+class _Handler(BaseHTTPRequestHandler):
+    engine: EditEngine  # set by make_server on the handler subclass
+    protocol_version = "HTTP/1.1"
+
+    # ---- plumbing --------------------------------------------------------
+
+    def log_message(self, fmt, *args):  # quiet by default; the ledger records
+        pass
+
+    def _send(self, code: int, payload: Dict[str, Any]) -> None:
+        body = json.dumps(payload, default=str).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _error(self, code: int, message: str) -> None:
+        self._send(code, {"error": message})
+
+    # ---- routes ----------------------------------------------------------
+
+    def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler contract
+        url = urlparse(self.path)
+        try:
+            if url.path == "/healthz":
+                self._send(200, {
+                    "ok": True,
+                    "warm": self.engine.programs.warmed,
+                    "spec_fingerprint": self.engine.spec.fingerprint(),
+                })
+                return
+            if url.path == "/metrics":
+                self._send(200, self.engine.metrics())
+                return
+            m = _EDIT_PATH.match(url.path)
+            if m:
+                rid, want_result = m.group(1), bool(m.group(2))
+                if want_result:
+                    wait_s = float(
+                        parse_qs(url.query).get("wait_s", ["0"])[0]
+                    )
+                    self._send(200, self.engine.result(rid, wait_s=wait_s))
+                else:
+                    self._send(200, self.engine.poll(rid))
+                return
+            self._error(404, f"no route for {url.path}")
+        except KeyError as e:
+            self._error(404, str(e))
+        except Exception as e:  # noqa: BLE001 — a handler crash must not kill the server
+            self._error(500, f"{type(e).__name__}: {e}")
+
+    def do_POST(self) -> None:  # noqa: N802
+        url = urlparse(self.path)
+        try:
+            if url.path != "/v1/edits":
+                self._error(404, f"no route for {url.path}")
+                return
+            length = int(self.headers.get("Content-Length", "0"))
+            try:
+                body = json.loads(self.rfile.read(length) or b"{}")
+                request = EditRequest.from_dict(body)
+                rid = self.engine.submit(request)
+            except (ValueError, TypeError) as e:
+                self._error(400, str(e))
+                return
+            self._send(202, {"id": rid})
+        except Exception as e:  # noqa: BLE001
+            self._error(500, f"{type(e).__name__}: {e}")
+
+
+class EditServer:
+    """A ThreadingHTTPServer bound to one engine; ``serve_forever`` in a
+    daemon thread so in-process callers (tests, the UI) can keep going."""
+
+    def __init__(self, engine: EditEngine, host: str = "127.0.0.1",
+                 port: int = 0):
+        handler = type("BoundHandler", (_Handler,), {"engine": engine})
+        self.engine = engine
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self.httpd.server_address[:2]
+
+    @property
+    def url(self) -> str:
+        host, port = self.address
+        return f"http://{host}:{port}"
+
+    def start(self) -> "EditServer":
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="edit-http", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        self.httpd.serve_forever()
+
+    def close(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+
+
+def make_server(engine: EditEngine, *, host: str = "127.0.0.1",
+                port: int = 0) -> EditServer:
+    return EditServer(engine, host=host, port=port)

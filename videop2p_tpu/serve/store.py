@@ -1,0 +1,197 @@
+"""Content-addressed inversion-product store for the serving engine.
+
+Two layers over one key space (:func:`videop2p_tpu.utils.inv_cache.
+inversion_cache_key` — every determinant of the products is in the key, so
+stale hits are impossible by construction):
+
+  * **device-resident LRU** — the serving hot path. An entry holds the full
+    :class:`~videop2p_tpu.pipelines.cached.CachedSource` capture plus the
+    encoded source latents (the ``anchor`` the edit program checks
+    ``src_err`` against), still on device, so a repeat edit of the same
+    clip skips VAE encode AND the DDIM inversion walk entirely and its
+    source stream replays with ``src_err == 0.0``. Entries are bounded by
+    a byte budget (``tree_bytes`` of the device pytree) with
+    least-recently-used eviction — the capture trees are the HBM cliff
+    (~3 GB at SD scale per clip), so residency is a budgeted cache, not a
+    leak.
+  * **disk persistence** (optional) — the trajectory (the cheap,
+    checkpoint-portable product; ~26 MB at SD scale) is written through to
+    ``utils/inv_cache`` under a shared root so CLI runs, sweeps
+    (``cli/sweep.py --inv_store``) and engine restarts can reuse it. The
+    capture trees are NOT persisted (they are an HBM-scale artifact and
+    cheap to rebuild relative to their size on disk).
+
+Stdlib+numpy+jax only — the import-guard test walks this package like
+``obs/``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "InversionStore",
+    "StoreEntry",
+    "load_persisted_inversion",
+    "save_persisted_inversion",
+]
+
+
+def _tree_nbytes(tree: Any) -> int:
+    from videop2p_tpu.pipelines.cached import tree_bytes
+
+    return int(tree_bytes(tree))
+
+
+class StoreEntry:
+    """One resident entry: the device products plus bookkeeping."""
+
+    __slots__ = ("products", "nbytes", "hits", "meta")
+
+    def __init__(self, products: Any, nbytes: int, meta: Optional[Dict] = None):
+        self.products = products
+        self.nbytes = int(nbytes)
+        self.hits = 0
+        self.meta = dict(meta or {})
+
+
+class InversionStore:
+    """Byte-budgeted LRU of device-resident inversion products.
+
+    ``products`` is an arbitrary pytree (the engine stores
+    ``(cached: CachedSource, anchor: latents)``); the store only needs its
+    byte size. Thread-safe: the HTTP handlers read :meth:`stats` while the
+    engine worker mutates entries.
+    """
+
+    def __init__(self, byte_budget: int, *, persist_dir: Optional[str] = None):
+        if byte_budget <= 0:
+            raise ValueError(f"byte_budget must be positive, got {byte_budget}")
+        self.byte_budget = int(byte_budget)
+        self.persist_dir = persist_dir
+        self._entries: "OrderedDict[str, StoreEntry]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.rejected_oversize = 0
+
+    # ---- resident layer --------------------------------------------------
+
+    def get(self, key: str) -> Optional[Any]:
+        """Products on a hit (entry becomes most-recently-used), else None."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            entry.hits += 1
+            self.hits += 1
+            return entry.products
+
+    def put(self, key: str, products: Any, *,
+            trajectory: Optional[np.ndarray] = None,
+            meta: Optional[Dict] = None) -> bool:
+        """Insert (or refresh) an entry, evicting LRU entries until the
+        budget holds. An entry larger than the whole budget is rejected
+        (recorded in ``rejected_oversize``) rather than evicting everything
+        for a cache that can never hit. ``trajectory`` (inversion-walk
+        order, host array) is written through to the disk layer when
+        persistence is configured. Returns True when resident."""
+        nbytes = _tree_nbytes(products)
+        if self.persist_dir is not None and trajectory is not None:
+            save_persisted_inversion(self.persist_dir, key, trajectory, meta=meta)
+        with self._lock:
+            if nbytes > self.byte_budget:
+                self.rejected_oversize += 1
+                self._entries.pop(key, None)
+                return False
+            if key in self._entries:
+                self._entries.pop(key)
+            while self._entries and self._bytes_locked() + nbytes > self.byte_budget:
+                self._entries.popitem(last=False)  # least recently used
+                self.evictions += 1
+            self._entries[key] = StoreEntry(products, nbytes, meta)
+            return True
+
+    def _bytes_locked(self) -> int:
+        return sum(e.nbytes for e in self._entries.values())
+
+    def __contains__(self, key: str) -> bool:
+        with self._lock:
+            return key in self._entries
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def keys(self):
+        with self._lock:
+            return list(self._entries)
+
+    def stats(self) -> Dict[str, Any]:
+        """The ``/metrics`` store section: residency, budget and hit rates."""
+        with self._lock:
+            entries = len(self._entries)
+            in_use = self._bytes_locked()
+        total = self.hits + self.misses
+        return {
+            "entries": entries,
+            "bytes_in_use": in_use,
+            "byte_budget": self.byte_budget,
+            "hits": self.hits,
+            "misses": self.misses,
+            "evictions": self.evictions,
+            "rejected_oversize": self.rejected_oversize,
+            "hit_rate": round(self.hits / total, 4) if total else None,
+        }
+
+
+# ---- disk layer (shared with the CLIs) -----------------------------------
+#
+# These wrappers ARE utils/inv_cache with an explicit root: the CLI's
+# per-results-dir persistence and the shared --inv_store root go through the
+# same content-addressed entry layout, so a sweep, a one-shot CLI run and a
+# serving engine can all reuse one inversion of a clip.
+
+
+def load_persisted_inversion(
+    root: str, key: str, *, want_null: bool = False, null_tag: str = ""
+) -> Optional[Tuple[np.ndarray, Optional[np.ndarray]]]:
+    """(trajectory, null_embeddings-or-None) from the disk layer, or None."""
+    from videop2p_tpu.utils.inv_cache import load_inversion
+
+    if not root:
+        return None
+    return load_inversion(root, key, want_null=want_null, null_tag=null_tag)
+
+
+def save_persisted_inversion(
+    root: str,
+    key: str,
+    trajectory: Optional[np.ndarray] = None,
+    null_embeddings: Optional[np.ndarray] = None,
+    *,
+    null_tag: str = "",
+    meta: Optional[Dict] = None,
+) -> Optional[str]:
+    """Write products to the disk layer (atomic, first-writer-wins — see
+    ``utils/inv_cache.save_inversion``); never raises (persistence is an
+    amortization, not a correctness dependency)."""
+    from videop2p_tpu.utils.inv_cache import save_inversion
+
+    if not root:
+        return None
+    try:
+        os.makedirs(root, exist_ok=True)
+        return save_inversion(
+            root, key, trajectory, null_embeddings, null_tag=null_tag, meta=meta
+        )
+    except OSError:
+        return None

@@ -21,7 +21,7 @@ from videop2p_tpu.ui.upload import ModelUploader, UploadTarget
 DEFAULT_BASE_MODEL = "runwayml/stable-diffusion-v1-5"
 
 
-def build_app():
+def build_app(engine_url=None):
     try:
         import gradio as gr
     except ImportError as exc:  # pragma: no cover - env-dependent
@@ -33,6 +33,9 @@ def build_app():
 
     trainer = Trainer()
     inference = InferencePipeline()
+    # the Edit tab's serving path: a healthy cli/serve.py engine at this
+    # URL (or VIDEOP2P_SERVE_URL) serves edits warm; else subprocess CLI
+    engine_url = engine_url or os.environ.get("VIDEOP2P_SERVE_URL")
 
     def do_train(video_dir, train_prompt, val_prompt, model_name, base_model,
                  n_steps, lr, seed):
@@ -53,6 +56,7 @@ def build_app():
         # Stage-1 mangles its on-disk dir with the dependent suffix; the
         # Stage-2 CLI re-derives it from the same (default) flags
         trainer.run_p2p(
+            engine_url=engine_url,
             output_dir=exp_dir,
             video_path=video_dir,
             training_prompt=train_prompt,
@@ -161,6 +165,10 @@ def build_app():
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--share", action="store_true")
+    ap.add_argument("--engine", type=str, default=None,
+                    help="URL of a running cli/serve.py engine; the Edit "
+                         "tab serves through it (warm programs + inversion "
+                         "store) instead of spawning a subprocess")
     ap.add_argument("--port", type=int, default=7860)
     args = ap.parse_args()
-    build_app().launch(share=args.share, server_port=args.port)
+    build_app(engine_url=args.engine).launch(share=args.share, server_port=args.port)

@@ -1,40 +1,56 @@
-"""In-process inference wrapper for the demo UI.
+"""In-process inference wrapper for the demo UI, plus the engine-backed
+edit path.
 
 Re-design of /root/reference/gradio_utils/inference.py: loads a tuned
 experiment checkpoint once, then samples videos for arbitrary prompts
 (optionally from the stored DDIM-inverted latent, inference.py:73-96) and
-writes the result as a GIF for the UI to display.
+writes the result as a GIF for the UI to display. The model/program wiring
+now lives in :class:`videop2p_tpu.serve.programs.ProgramSet` — repeat UI
+samples with the same step count reuse ONE warm compiled program instead
+of re-tracing per request.
+
+:func:`edit_via_engine` is the UI's serving path: when a
+``cli/serve.py`` engine is up (``VIDEOP2P_SERVE_URL`` or the app's
+``--engine`` flag), the Edit tab submits to it over HTTP — no subprocess,
+no recompile, warm inversion store — and falls back to the subprocess CLI
+when the engine is absent or unhealthy.
 """
 
 from __future__ import annotations
 
 import glob
 import os
-from typing import Optional
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["InferencePipeline"]
+__all__ = ["InferencePipeline", "edit_via_engine"]
 
 
 class InferencePipeline:
     def __init__(self, checkpoint_dir: Optional[str] = None):
         self.checkpoint_dir: Optional[str] = None
-        self._bundle = None
+        self._programs = None
         if checkpoint_dir:
             self.load(checkpoint_dir)
 
     def load(self, checkpoint_dir: str) -> None:
         """(Re)load a tuned pipeline dir; no-op if already loaded
         (inference.py:47-59)."""
-        if checkpoint_dir == self.checkpoint_dir and self._bundle is not None:
+        if checkpoint_dir == self.checkpoint_dir and self._programs is not None:
             return
-        from videop2p_tpu.cli.common import build_models
+        from videop2p_tpu.serve.programs import ProgramSet, ProgramSpec
 
-        self._bundle = build_models(checkpoint_dir, dtype=jnp.bfloat16)
+        self._programs = ProgramSet(
+            ProgramSpec(checkpoint=checkpoint_dir, mixed_precision="bf16")
+        )
         self.checkpoint_dir = checkpoint_dir
+
+    @property
+    def _bundle(self):
+        return self._programs.bundle if self._programs is not None else None
 
     def _latest_inv_latent(self) -> Optional[np.ndarray]:
         """The newest Stage-1 validation inversion latent, if any
@@ -59,15 +75,11 @@ class InferencePipeline:
         width: int = 512,
     ) -> str:
         """Sample one video and write it to ``out_path``; returns the path."""
-        if self._bundle is None:
+        if self._programs is None:
             raise RuntimeError("load() a checkpoint dir first")
-        from videop2p_tpu.cli.common import encode_prompts
-        from videop2p_tpu.core import DDIMScheduler
-        from videop2p_tpu.models import decode_video
-        from videop2p_tpu.pipelines import edit_sample, make_unet_fn
         from videop2p_tpu.utils.video_io import save_video_gif
 
-        bundle = self._bundle
+        ps = self._programs
         key, noise_key, edit_key = jax.random.split(jax.random.key(seed), 3)
         expected_shape = (1, video_length, height // 8, width // 8, 4)
         x_t = None
@@ -84,13 +96,57 @@ class InferencePipeline:
                     )
         if x_t is None:
             x_t = jax.random.normal(noise_key, expected_shape, jnp.float32)
-        cond = encode_prompts(bundle, [prompt])
-        uncond = encode_prompts(bundle, [""])[0]
-        unet_fn = make_unet_fn(bundle.unet)
-        out = edit_sample(
-            unet_fn, bundle.unet_params, bundle.make_scheduler(), x_t, cond, uncond,
-            num_inference_steps=num_steps, guidance_scale=guidance_scale, key=edit_key,
+        cond = ps.encode_prompts([prompt])
+        uncond = ps.encode_prompts([""])[0]
+        # CFG sample + decode as ONE warm instrumented program
+        # (serve/programs.py sample_decode) — repeat requests reuse it
+        video01 = ps.sample(
+            x_t, cond, uncond, edit_key,
+            steps=num_steps, guidance_scale=guidance_scale,
         )
-        frames = decode_video(bundle.vae, bundle.vae_params, out.astype(jnp.bfloat16))
-        video = np.asarray(jax.device_get((frames.astype(jnp.float32) + 1) / 2))[0]
+        video = np.asarray(jax.device_get(video01))[0]
         return save_video_gif(video, out_path, fps=8)
+
+
+def edit_via_engine(
+    engine_url: Optional[str],
+    p2p_cfg: Dict[str, Any],
+    *,
+    timeout_s: float = 600.0,
+) -> Optional[str]:
+    """Run one P2P edit through a serving engine; None means "use the
+    subprocess fallback" (no/unhealthy engine, or the request failed).
+
+    ``p2p_cfg`` is the Stage-2 config dict the UI already assembles
+    (:meth:`videop2p_tpu.ui.trainer.Trainer.build_p2p_config`); the fields
+    the engine does not key on (``pretrained_model_path`` — the server was
+    started for a fixed checkpoint spec; ``video_len`` — fixed by the
+    server's geometry) are dropped here. Returns the edited GIF path
+    (server-local) on success.
+    """
+    from videop2p_tpu.serve.client import EngineClient, engine_available
+
+    if not engine_available(engine_url):
+        return None
+    request = {
+        k: p2p_cfg[k]
+        for k in ("image_path", "prompt", "prompts", "save_name",
+                  "is_word_swap", "blend_word", "eq_params",
+                  "cross_replace_steps", "self_replace_steps")
+        if k in p2p_cfg
+    }
+    try:
+        client = EngineClient(engine_url)
+        rid = client.submit(request)
+        record = client.wait(rid, timeout_s=timeout_s)
+    except Exception as e:  # noqa: BLE001 — engine trouble falls back, never crashes the UI
+        print(f"[ui] engine edit failed ({e}) — falling back to subprocess")
+        return None
+    if record.get("status") != "done":
+        print(f"[ui] engine edit error: {record.get('error')} — "
+              "falling back to subprocess")
+        return None
+    print(f"[ui] engine edit done in {record.get('total_s')}s "
+          f"(store hit: {record.get('store_hit')}, "
+          f"compiles: {record.get('compile_events')})")
+    return record.get("edit_gif")

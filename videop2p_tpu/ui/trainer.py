@@ -222,13 +222,26 @@ class Trainer:
         return exp_dir.as_posix()
 
     def run_p2p(self, *, fast: bool = True, extra_flags: Optional[List[str]] = None,
-                **kwargs) -> str:
-        """Stage-2 run against a finished experiment dir. Returns that dir."""
+                engine_url: Optional[str] = None, **kwargs) -> str:
+        """Stage-2 run against a finished experiment dir. Returns that dir.
+
+        With ``engine_url`` (or ``VIDEOP2P_SERVE_URL``) pointing at a
+        healthy ``cli/serve.py`` engine, the edit is served in-process by
+        the warm engine (no subprocess, no recompile, inversion-store
+        reuse); an absent/unhealthy engine or a failed engine request
+        falls back to the subprocess CLI path unchanged."""
         exp_dir = pathlib.Path(kwargs["output_dir"])
         cfg = self.build_p2p_config(**kwargs)
         config_path = exp_dir / "p2p_config.yaml"
         with open(config_path, "w") as f:
             yaml.safe_dump(cfg, f, sort_keys=False)
+        engine_url = engine_url or os.environ.get("VIDEOP2P_SERVE_URL")
+        if engine_url:
+            from videop2p_tpu.ui.inference import edit_via_engine
+
+            gif = edit_via_engine(engine_url, cfg)
+            if gif is not None:
+                return exp_dir.as_posix()
         flags = list(extra_flags or [])
         if fast:
             flags.append("--fast")
