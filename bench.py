@@ -567,13 +567,17 @@ def record_program_analyses(rec, analyses, *, backend, baseline_dir=None):
 
 
 def official_e2e_records(inv_s, edit_s, *, null_fp32_s=None, null_mixed_s=None,
+                         null_amortized_s=None, null_hybrid_s=None,
                          inner_steps=None, baseline_s=V100_OFFICIAL_EDIT_S):
-    """The official-mode e2e record schema across the null-text precision
-    variants: each variant carries its e2e seconds, per-inner-Adam-step ms,
-    and vs-V100-baseline ratio. Any constituent may be None (off-TPU, or a
-    variant not measured this run) — the keys are still emitted with null
-    values so the record SHAPE is stable and machine-readable
-    (tests/test_null_text_precision.py exercises the schema on CPU)."""
+    """The official-mode e2e record schema across the null-text variants
+    (precision: fp32/mixed; mode: amortized/hybrid — ISSUE 8): each variant
+    carries its e2e seconds and vs-V100-baseline ratio, the Adam-loop
+    precisions additionally their per-inner-step ms (the amortized mode has
+    ZERO inner Adam steps — a per-inner-step figure would be meaningless).
+    Any constituent may be None (off-TPU, or a variant not measured this
+    run) — the keys are still emitted with null values so the record SHAPE
+    is stable and machine-readable (tests/test_null_text_precision.py
+    exercises the schema on CPU)."""
 
     def e2e(null_s):
         if inv_s is None or edit_s is None or null_s is None:
@@ -592,11 +596,92 @@ def official_e2e_records(inv_s, edit_s, *, null_fp32_s=None, null_mixed_s=None,
     return {
         "official_edit_e2e_fp32_s": e2e(null_fp32_s),
         "official_edit_e2e_mixed_s": e2e(null_mixed_s),
+        "official_edit_e2e_amortized_s": e2e(null_amortized_s),
+        "official_edit_e2e_hybrid_s": e2e(null_hybrid_s),
         "null_text_inner_step_fp32_ms": per_inner(null_fp32_s),
         "null_text_inner_step_mixed_ms": per_inner(null_mixed_s),
         "official_vs_baseline_fp32": vs(null_fp32_s),
         "official_vs_baseline_mixed": vs(null_mixed_s),
+        "official_vs_baseline_amortized": vs(null_amortized_s),
+        "official_vs_baseline_hybrid": vs(null_hybrid_s),
     }
+
+
+# the official CLI defaults the flop accounting below is stated at:
+# 50 outer steps × 10 inner Adam steps (run_videop2p.py), hybrid K=3
+NULL_TEXT_FLOP_DEFAULTS = dict(num_steps=50, num_inner_steps=10,
+                               hybrid_inner_steps=3)
+
+
+def null_text_flop_records(unit_fwd_flops, unit_inner_flops, *,
+                           num_steps=50, num_inner_steps=10,
+                           hybrid_inner_steps=3):
+    """Total inner-loop flops per null-text mode, from the two STRAIGHT-LINE
+    unit analyses (``null_text_unit_fwd`` = one UNet forward,
+    ``null_text_unit_inner`` = one inner Adam iteration: loss forward +
+    backward + update — tools/cpu_cost_capture.py builds both).
+
+    XLA's ``cost_analysis`` counts a ``scan``/``while`` body ONCE (the
+    static-count convention docs/PERF_ANALYSIS.md discloses), so the fused
+    null-text programs' own analyses cannot be compared across modes — the
+    optimize mode hides 50×10 inner iterations inside loops while the
+    hybrid mode materializes its step batch. The unit programs contain no
+    loops, so their static counts ARE their true flops; the per-mode totals
+    then follow from the loop structure, which is exact and disclosed:
+
+      optimize  = N·(2·fwd + I·inner)   (cond + final-uncond forwards, I
+                                         inner Adam iterations per step)
+      amortized = N·fwd                 (closed form: one forward per step)
+      hybrid    = N·(fwd + K·inner)     (cond forward + K joint iterations)
+
+    Returns the machine-readable record bench_details.json carries,
+    including the ≥3× reduction ratios the ISSUE-8 acceptance gates (with
+    I=10, K=3 the hybrid ratio is ≥3 for ANY inner/fwd cost ratio ≥1)."""
+    f, i = float(unit_fwd_flops), float(unit_inner_flops)
+    n = int(num_steps)
+    opt = n * (2 * f + num_inner_steps * i)
+    amo = n * f
+    hyb = n * (f + hybrid_inner_steps * i)
+    return {
+        "null_text_unit_fwd_flops": f,
+        "null_text_unit_inner_flops": i,
+        "null_text_flop_params": {
+            "num_steps": n, "num_inner_steps": int(num_inner_steps),
+            "hybrid_inner_steps": int(hybrid_inner_steps),
+        },
+        "null_text_total_flops_optimize": opt,
+        "null_text_total_flops_amortized": amo,
+        "null_text_total_flops_hybrid": hyb,
+        "null_text_flops_reduction_amortized": round(opt / amo, 2),
+        "null_text_flops_reduction_hybrid": round(opt / hyb, 2),
+    }
+
+
+def record_null_text_flops(rec, *, tiny=False, timeout_s=None,
+                           frames=None, steps=None) -> None:
+    """Capture the two null-text unit analyses (CPU subprocess — flop
+    counts are backend-independent and need no healthy accelerator) and
+    persist the per-mode totals + reduction ratios. Best-effort: a failed
+    capture records nothing rather than killing the round."""
+    timeout_s = timeout_s if timeout_s is not None else float(os.environ.get(
+        "VIDEOP2P_BENCH_CPU_ANALYSIS_TIMEOUT", "900"))
+    analyses = collect_cpu_analysis(
+        frames if frames is not None else BENCH_FRAMES,
+        steps if steps is not None else BENCH_STEPS,
+        timeout_s=timeout_s, tiny=tiny,
+        programs=("null_text_unit_fwd", "null_text_unit_inner"),
+    )
+    fwd = analyses.get("null_text_unit_fwd", {}).get("flops")
+    inner = analyses.get("null_text_unit_inner", {}).get("flops")
+    if not fwd or not inner:
+        print("[bench] null-text unit flop capture incomplete "
+              f"(have {sorted(analyses)}) — skipping the mode flop record",
+              file=sys.stderr, flush=True)
+        return
+    for k, v in null_text_flop_records(
+        fwd, inner, **NULL_TEXT_FLOP_DEFAULTS
+    ).items():
+        rec.record(k, v)
 
 
 def build_fast_edit_working_point(*, num_frames: int = 8, num_steps: int = 50,
@@ -720,6 +805,179 @@ def build_fast_edit_working_point(*, num_frames: int = 8, num_steps: int = 50,
     )
 
 
+def run_step_frontier(fn, params, sched, cond, uncond, x0, *,
+                      base_steps=50, step_counts=(50, 20, 8), timed=True,
+                      guidance_scale=7.5):
+    """The latency-vs-quality step frontier (ISSUE 8 / ROADMAP item 3):
+    from ONE ``base_steps`` captured inversion, run the cached fast edit at
+    every requested step count via exact timestep-subset schedules
+    (core/ddim.py ``subset_positions``) and score each variant against the
+    base-steps edit with the obs/quality metrics (PSNR / SSIM /
+    background-preservation outside the capture's LocalBlend mask /
+    adjacent-frame consistency). The source replay stays EXACT at every
+    step count (``src_err`` must read 0.0 — stream 0 is the trajectory's
+    x_0 by construction, steps or no steps).
+
+    Returns ``(records, outputs)`` — one JSON-safe record per step count
+    (non-finite metric values become null) in base-steps-first order.
+    """
+    import math
+
+    from videop2p_tpu.control import make_controller
+    from videop2p_tpu.control.local_blend import blend_mask
+    from videop2p_tpu.obs.quality import (
+        adjacent_frame_psnr,
+        masked_psnr,
+        psnr,
+        ssim,
+    )
+    from videop2p_tpu.pipelines import ddim_inversion_captured, edit_sample
+    from videop2p_tpu.pipelines.cached import capture_windows
+    from videop2p_tpu.utils.tokenizers import WordTokenizer
+
+    def _jf(v, nd=2):
+        v = float(v)
+        return round(v, nd) if math.isfinite(v) else None
+
+    prompts = ["a rabbit is jumping on the grass",
+               "a origami rabbit is jumping on the grass"]
+
+    def ctl(steps):
+        # the bench working point's controller, rebuilt per step count —
+        # subset edits gate in their OWN step space
+        return make_controller(
+            prompts, WordTokenizer(), num_steps=steps,
+            is_replace_controller=False,
+            cross_replace_steps=0.2, self_replace_steps=0.5,
+            blend_words=(["rabbit"], ["rabbit"]),
+            equalizer_params={"words": ["origami"], "values": [2.0]},
+        )
+
+    base_steps = int(base_steps)
+    ctx_base = ctl(base_steps)
+    cross_len, self_window = capture_windows(ctx_base, base_steps)
+    traj, cached = jax.jit(
+        lambda p, x: ddim_inversion_captured(
+            fn, p, sched, x, cond[:1], num_inference_steps=base_steps,
+            cross_len=cross_len, self_window=self_window, capture_blend=True,
+        )
+    )(params, x0)
+    x_t = traj[-1]
+    x0_f = jnp.asarray(x0[0], jnp.float32)
+    span = float(jnp.max(x0_f) - jnp.min(x0_f))
+    # the LocalBlend mask the capture implies (the source's summed per-step
+    # blend contributions): background-preservation scores its complement
+    mask = None
+    if cached.blend_seq is not None:
+        maps_sum = jnp.sum(cached.blend_seq.astype(jnp.float32), axis=0)
+        mask = blend_mask(maps_sum, ctx_base.blend, x0.shape[2:4])[0]
+
+    counts = [base_steps] + [int(s) for s in step_counts
+                             if int(s) != base_steps]
+    records, outputs = [], {}
+    base_edit, base_wall = None, None
+    for steps in counts:
+        positions = (None if steps == base_steps else tuple(
+            int(i) for i in sched.subset_positions(base_steps, steps)
+        ))
+        ctx_s = ctx_base if steps == base_steps else ctl(steps)
+        prog = jax.jit(
+            lambda p, xt, cch, _ctx=ctx_s, _n=steps, _pos=positions:
+            edit_sample(
+                fn, p, sched, xt, cond, uncond,
+                num_inference_steps=_n, guidance_scale=guidance_scale,
+                ctx=_ctx, source_uses_cfg=False, cached_source=cch,
+                step_positions=_pos,
+            )
+        )
+        out = hard_block(prog(params, x_t, cached))  # compile + scored output
+        edit_s = None
+        if timed:
+            # timing run on a value-perturbed x_T: the axon tunnel memoizes
+            # identical (executable, args) executions server-side
+            t0 = time.perf_counter()
+            hard_block(prog(params, x_t * (1.0 + 1e-6), cached))
+            edit_s = round(time.perf_counter() - t0, 3)
+        edit = out[1].astype(jnp.float32)
+        rec = {
+            "steps": steps,
+            "base_steps": base_steps,
+            "edit_s": edit_s,
+            "src_err": float(jnp.max(jnp.abs(
+                out[0].astype(jnp.float32) - x0_f
+            ))),
+            "edit_adjacent_psnr_db": _jf(jnp.mean(
+                adjacent_frame_psnr(edit, data_range=span)
+            )),
+        }
+        if steps == base_steps:
+            base_edit, base_wall = edit, edit_s
+            rec.update(vs_full_psnr_db=None, vs_full_ssim=None,
+                       speedup_vs_full=None)
+        else:
+            rec["vs_full_psnr_db"] = _jf(psnr(edit, base_edit, data_range=span))
+            rec["vs_full_ssim"] = _jf(ssim(edit, base_edit, data_range=span), 4)
+            rec["speedup_vs_full"] = (
+                round(base_wall / edit_s, 2)
+                if timed and base_wall and edit_s else None
+            )
+        if mask is not None:
+            bg = (1.0 - mask.astype(jnp.float32))[..., None]
+            rec["background_psnr_db"] = _jf(
+                masked_psnr(edit, x0_f, bg, data_range=span)
+            )
+            rec["mask_coverage"] = _jf(jnp.mean(mask.astype(jnp.float32)), 4)
+        else:
+            rec["background_psnr_db"] = None
+            rec["mask_coverage"] = None
+        records.append(rec)
+        outputs[steps] = out
+    return records, outputs
+
+
+def collect_step_frontier(*, timeout_s=900.0, tiny=True, frames=2,
+                          base_steps=50, step_counts=(50, 20, 8)):
+    """Run ``tools/step_frontier.py`` in a CPU SUBPROCESS (same isolation
+    rationale as :func:`collect_cpu_analysis`: this is the backend-down
+    path, and the parent's jax may hold a poisoned backend init) and parse
+    its one-JSON-line-per-step-count output. A timeout keeps the step
+    counts that finished. Never raises."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    cmd = [sys.executable, os.path.join(repo, "tools", "step_frontier.py"),
+           "--frames", str(frames), "--base_steps", str(base_steps),
+           "--steps", ",".join(str(s) for s in step_counts)]
+    if tiny:
+        cmd.append("--tiny")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    stdout = ""
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout_s, env=env)
+        stdout = proc.stdout or ""
+        if proc.returncode != 0:
+            print(f"[bench] step frontier rc={proc.returncode}: "
+                  f"{(proc.stderr or '')[-300:]}", file=sys.stderr, flush=True)
+    except subprocess.TimeoutExpired as e:
+        stdout = (e.stdout.decode() if isinstance(e.stdout, bytes)
+                  else e.stdout) or ""
+        print(f"[bench] step frontier timed out after {timeout_s:.0f}s — "
+              "keeping the step counts that finished", file=sys.stderr,
+              flush=True)
+    except OSError as e:
+        print(f"[bench] step frontier failed to launch: {e}",
+              file=sys.stderr, flush=True)
+    records = []
+    for line in stdout.splitlines():
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and "steps" in rec:
+            records.append(rec)
+    return records
+
+
 _GN_PROBE_SCRIPT = """
 import jax, jax.numpy as jnp
 from videop2p_tpu.ops.groupnorm import fused_group_norm
@@ -793,11 +1051,21 @@ def record_cpu_only_evidence(repo_dir=None) -> None:
     if not analyses:
         rec.record("cpu_analysis_error",
                    "cpu cost capture produced no programs")
-        return
-    record_program_analyses(rec, analyses, backend="cpu", baseline_dir=repo)
-    print(f"[bench] backend down — recorded CPU cost/memory analyses for "
-          f"{sorted(analyses)} in bench_details.json", file=sys.stderr,
-          flush=True)
+    else:
+        record_program_analyses(rec, analyses, backend="cpu",
+                                baseline_dir=repo)
+        print(f"[bench] backend down — recorded CPU cost/memory analyses "
+              f"for {sorted(analyses)} in bench_details.json",
+              file=sys.stderr, flush=True)
+    # the ISSUE-8 evidence survives a dead chip too: per-mode null-text
+    # inner-loop flop totals from the straight-line unit analyses, and the
+    # tiny-scale CPU step frontier (executed — quality metrics per step
+    # count, wall-clock disclosed as CPU-tiny, never a TPU claim)
+    record_null_text_flops(rec, timeout_s=timeout_s)
+    frontier = collect_step_frontier(timeout_s=timeout_s, tiny=True)
+    if frontier:
+        rec.record("latency_quality_frontier", frontier)
+        rec.record("latency_quality_frontier_backend", "cpu-tiny")
 
 
 def main() -> None:
@@ -1443,14 +1711,69 @@ def main() -> None:
                        round(float(jnp.mean(nml)
                                    / jnp.maximum(jnp.mean(nfl), 1e-12)), 3),
                        derived=(r_nmix, r_nfix))
-            # both variants' e2e + per-inner-step + vs-baseline in one
+            # structural null-text variants (ISSUE 8): the closed-form
+            # amortized substitute (zero inner Adam steps, one forward per
+            # outer step) and the joint-refinement hybrid (K=3 batched
+            # across all outer steps), both through the same fused program
+            # path and both with reconstruction parity recorded against the
+            # SAME x_0 via the already-compiled official edit
+            mode_seconds = {}
+            for mode, floor_fwd_eq in (("amortized", 1), ("hybrid", 1 + 3 * 3)):
+                jax.clear_caches()
+
+                def null_opt_mode(p, tr, _m=mode):
+                    return null_text_optimization_fused(
+                        fn_remat, p, sched, tr, cond[:1], uncond[None],
+                        num_inference_steps=STEPS, guidance_scale=7.5,
+                        null_text_mode=_m, hybrid_inner_steps=3,
+                        donate=False, return_stats=True,
+                    )
+
+                r_m = measure_with_floor(
+                    lambda tr: null_opt_mode(params, tr),
+                    [traj, traj_extra],
+                    floor_fwd_eq * STEPS * F * FLOPS_PER_FRAME_FWD / peak,
+                    f"null-text {mode}",
+                )
+                (null_seq_m, m_stats), m_s = r_m.out, r_m.seconds
+                rec.record(f"null_text_{mode}_s", round(m_s, 3), reading=r_m)
+                rec.record(
+                    f"null_{mode}_recon_loss_mean",
+                    float(jnp.mean(m_stats["final_loss"].astype(jnp.float32))),
+                    derived=(r_m,),
+                )
+                # parity evidence on the END-TO-END reconstruction: the CFG
+                # replay driven by this mode's embeddings vs the same x_0
+                # the fixed-3 record used (official_fixed3_recon_psnr_db)
+                recon_m = hard_block(
+                    edit_official(params, null_traj_last, null_seq_m)
+                )[0]
+                mse_m = float(jnp.mean(
+                    (recon_m.astype(jnp.float32)
+                     - null_traj_x0[0].astype(jnp.float32)) ** 2
+                ))
+                rec.record(
+                    f"official_{mode}_recon_psnr_db",
+                    round(10 * _math.log10(span * span / max(mse_m, 1e-12)), 2),
+                    derived=(r_m, r_off),
+                )
+                mode_seconds[mode] = m_s
+                del null_seq_m, m_stats, recon_m, r_m
+
+            # all four variants' e2e + per-inner-step + vs-baseline in one
             # schema (CPU-tested, so the record shape cannot drift)
             for k, v in official_e2e_records(
                 inv_live_s, edit_off_s,
                 null_fp32_s=nfix_s, null_mixed_s=nmix_s,
+                null_amortized_s=mode_seconds.get("amortized"),
+                null_hybrid_s=mode_seconds.get("hybrid"),
                 inner_steps=STEPS * INNER_FIXED,
             ).items():
                 rec.record(k, v, derived=(r_linv, r_nfix, r_nmix, r_off))
+            # per-mode inner-loop flop totals from the straight-line unit
+            # analyses (CPU subprocess — flop counts are backend-blind);
+            # the ISSUE-8 ≥3× acceptance reads these reduction ratios
+            record_null_text_flops(rec)
             del nmix_stats, r_nmix
 
             # Stage-1 tuning step on a cleared chip (its grad program +
@@ -1734,6 +2057,21 @@ def main() -> None:
             rec.record("sdxl_ctrl_step_ms", round(r_sxc.seconds * 1e3, 0),
                        reading=r_sxc)
             del sx_params, r_sxc
+            jax.clear_caches()
+
+            # latency-vs-quality step frontier (ISSUE 8 / ROADMAP item 3):
+            # 20- and 8-step cached fast-path variants run e2e from ONE
+            # 50-step inversion via exact timestep subsets, each scored
+            # against the full-step edit with the obs/quality metrics —
+            # the frontier table docs/PERF_ANALYSIS.md renders
+            frontier, _ = run_step_frontier(
+                fn, params, sched, cond, uncond, x0,
+                base_steps=STEPS, step_counts=(STEPS, 20, 8),
+            )
+            assert all(r["src_err"] == 0.0 for r in frontier), frontier
+            rec.record("latency_quality_frontier", frontier)
+            rec.record("latency_quality_frontier_backend",
+                       jax.devices()[0].platform)
             jax.clear_caches()
 
             # reference-faithful null-text inversion LAST (50 outer × ≤10

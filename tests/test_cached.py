@@ -428,6 +428,92 @@ def test_fused_helper_matches_two_call_path(sched, tiny, ctx5):
     np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_two), atol=2e-3)
 
 
+def test_step_subset_cached_replay_exact_and_identity(sched, tiny, ctx5):
+    """ISSUE 8: the few-step cached edit from a full capture. The identity
+    subset is BIT-identical to the plain path (the subset seam changes
+    nothing at full count), and a 2-of-5 subset still replays the source
+    exactly (stream 0 == x_0 — src_err 0.0 at any step count) while the
+    edit stream genuinely takes fewer, larger steps."""
+    fn, params, cfg = tiny
+    x0 = jax.random.normal(jax.random.key(30), SHAPE)
+    cond = jax.random.normal(jax.random.key(31), (2, 77, cfg.cross_attention_dim))
+    uncond = jnp.zeros((77, cfg.cross_attention_dim))
+    c, sw = _windows(ctx5, STEPS)
+    traj, cached, out_full = _run_cached(
+        fn, params, sched, x0, cond, uncond, ctx5, c, sw
+    )
+    out_id = jax.jit(
+        lambda p, xt, cch: edit_sample(
+            fn, p, sched, xt, cond, uncond, num_inference_steps=STEPS,
+            ctx=ctx5, source_uses_cfg=False, blend_res=(4, 4),
+            cached_source=cch, step_positions=tuple(range(STEPS)),
+        )
+    )(params, traj[-1], cached)
+    np.testing.assert_array_equal(np.asarray(out_id), np.asarray(out_full))
+
+    pos = tuple(int(i) for i in sched.subset_positions(STEPS, 2))
+    ctx2 = make_controller(
+        ["a rabbit is jumping", "a origami rabbit is jumping"],
+        WordTokenizer(), num_steps=2,
+        is_replace_controller=False,
+        cross_replace_steps=0.4, self_replace_steps=0.6,
+        blend_words=(["rabbit"], ["rabbit"]),
+        equalizer_params={"words": ["origami"], "values": [2.0]},
+    )
+    out2 = jax.jit(
+        lambda p, xt, cch: edit_sample(
+            fn, p, sched, xt, cond, uncond, num_inference_steps=2,
+            ctx=ctx2, source_uses_cfg=False, blend_res=(4, 4),
+            cached_source=cch, step_positions=pos,
+        )
+    )(params, traj[-1], cached)
+    np.testing.assert_array_equal(np.asarray(out2[0]), np.asarray(x0[0]))
+    assert np.isfinite(np.asarray(out2)).all()
+    assert not np.allclose(np.asarray(out2[1]), np.asarray(out_full[1]))
+
+
+def test_step_subset_validation(sched, tiny, ctx5):
+    """The subset seam's guard rails: malformed positions, count
+    mismatches, cached-less use, and gated steps mapping outside the
+    captured windows all raise before any device work."""
+    fn, params, cfg = tiny
+    x0 = jax.random.normal(jax.random.key(32), SHAPE)
+    cond = jax.random.normal(jax.random.key(33), (2, 77, cfg.cross_attention_dim))
+    uncond = jnp.zeros((77, cfg.cross_attention_dim))
+    c, sw = _windows(ctx5, STEPS)
+    traj, cached, _ = _run_cached(
+        fn, params, sched, x0, cond, uncond, ctx5, c, sw
+    )
+
+    def run(positions, *, n, ctx=None, cch=cached):
+        return edit_sample(
+            fn, params, sched, traj[-1], cond, uncond,
+            num_inference_steps=n, ctx=ctx, source_uses_cfg=False,
+            blend_res=(4, 4), cached_source=cch, step_positions=positions,
+        )
+
+    with pytest.raises(ValueError, match="requires cached_source"):
+        run((0, 2), n=2, cch=None)
+    with pytest.raises(ValueError, match="strictly increasing"):
+        run((0, 3, 2), n=3)
+    with pytest.raises(ValueError, match="start at 0"):
+        run((1, 3), n=2)
+    with pytest.raises(ValueError, match="covers"):
+        run((0, STEPS), n=2)
+    with pytest.raises(ValueError, match="entries"):
+        run((0, 2), n=3)
+    # a controller whose self window maps past the captured window fails
+    # loudly (a clamped read would silently edit with stale maps)
+    ctx_wide = make_controller(
+        ["a rabbit is jumping", "a origami rabbit is jumping"],
+        WordTokenizer(), num_steps=2,
+        is_replace_controller=False,
+        cross_replace_steps=0.4, self_replace_steps=1.0,
+    )
+    with pytest.raises(ValueError, match="self window maps"):
+        run((0, STEPS - 1), n=2, ctx=ctx_wide)
+
+
 def test_cached_vs_live_controlled_delta_tracks_source_drift(sched, tiny, ctx5):
     """Quantify the cached-mode approximation WITH controllers (VERDICT r4
     item 2). The only input difference between the two paths is the source

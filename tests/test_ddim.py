@@ -112,6 +112,64 @@ def test_add_noise_and_velocity():
     assert s.training_target(x, n, t) is v or np.allclose(np.asarray(s.training_target(x, n, t)), np.asarray(v))
 
 
+def test_subset_schedule_is_exact_subset_of_base_grid():
+    """ISSUE 8: the few-step serving schedules visit EXACT base-grid
+    timesteps (so a base-steps inversion trajectory has a latent at every
+    visited point), start at x_T, and end on the base walk's own terminal
+    target (the same final ᾱ)."""
+    s = DDIMScheduler.create_sd()
+    base = s.timesteps(50)
+    for steps in (8, 20, 50):
+        pos, ts, prev = s.subset_schedule(50, steps)
+        assert pos.shape == ts.shape == prev.shape == (steps,)
+        assert pos[0] == 0  # starts at the base walk's x_T
+        assert (np.diff(pos) > 0).all()
+        np.testing.assert_array_equal(ts, base[pos])  # exact subset
+        assert set(ts.tolist()) <= set(base.tolist())
+        # each step lands on the next visited timestep; the last on the
+        # base walk's terminal target (< 0 → final_alpha_cumprod)
+        np.testing.assert_array_equal(prev[:-1], ts[1:])
+        assert prev[-1] == base[-1] - 1000 // 50
+    # steps == base reproduces the uniform rule exactly — subset walks at
+    # full count are the plain walk
+    pos, ts, prev = s.subset_schedule(50, 50)
+    np.testing.assert_array_equal(ts, base)
+    np.testing.assert_array_equal(prev, ts - 20)
+
+
+def test_subset_schedule_validation():
+    s = DDIMScheduler.create_sd()
+    with pytest.raises(ValueError, match="steps"):
+        s.subset_positions(50, 0)
+    with pytest.raises(ValueError, match="steps"):
+        s.subset_positions(50, 51)
+
+
+def test_step_with_explicit_prev_timestep_matches_uniform_rule():
+    """Passing the uniform prev timestep explicitly must reproduce the
+    default path bit-for-bit — the subset seam changes nothing at full
+    step count — and a non-uniform prev uses that ᾱ exactly."""
+    s = DDIMScheduler.create_sd()
+    x = jax.random.normal(jax.random.PRNGKey(9), (1, 2, 4, 4, 4))
+    eps = jax.random.normal(jax.random.PRNGKey(10), x.shape)
+    t = jnp.asarray(500)
+    d_prev, d_x0 = s.step(eps, t, x, 50)
+    e_prev, e_x0 = s.step(eps, t, x, 50, prev_timestep=jnp.asarray(480))
+    np.testing.assert_array_equal(np.asarray(d_prev), np.asarray(e_prev))
+    np.testing.assert_array_equal(np.asarray(d_x0), np.asarray(e_x0))
+    np.testing.assert_array_equal(
+        np.asarray(s.prev_step(eps, t, x, 50)),
+        np.asarray(s.prev_step(eps, t, x, 50, prev_timestep=jnp.asarray(480))),
+    )
+    # a larger jump (500 → 200) lands on ᾱ(200): closed form check
+    big, _ = s.step(eps, t, x, 50, prev_timestep=jnp.asarray(200))
+    a_t = np.asarray(s.alphas_cumprod)[500]
+    a_prev = np.asarray(s.alphas_cumprod)[200]
+    x0_ref = (np.asarray(x) - np.sqrt(1 - a_t) * np.asarray(eps)) / np.sqrt(a_t)
+    ref = np.sqrt(a_prev) * x0_ref + np.sqrt(1 - a_prev) * np.asarray(eps)
+    np.testing.assert_allclose(np.asarray(big), ref, rtol=1e-4, atol=1e-5)
+
+
 def test_from_config_maps_diffusers_keys():
     """Stage-2 builds its scheduler from the checkpoint's
     scheduler_config.json (run_videop2p.py:101-114) — known keys map,

@@ -206,6 +206,194 @@ def test_precision_knob_validation(sched, problem):
         )
 
 
+# ---------------------------------------- amortized / hybrid modes --------
+
+
+def test_mode_knob_validation_lists_valid_modes(sched, problem):
+    """ISSUE 8 satellite: an unknown null_text_mode raises a ValueError
+    naming every valid mode (the null_text_precision pattern), on both the
+    plain and the fused entry points."""
+    fn, _, cond, uncond, traj = problem
+    for bad in ("npi", "OPTIMIZE", ""):
+        with pytest.raises(ValueError, match="optimize.*amortized.*hybrid"):
+            null_text_optimization(
+                fn, None, sched, traj, cond, uncond,
+                num_inference_steps=STEPS, null_text_mode=bad,
+            )
+    with pytest.raises(ValueError, match="optimize.*amortized.*hybrid"):
+        null_text_optimization_fused(
+            fn, None, sched, traj, cond, uncond,
+            num_inference_steps=STEPS, null_text_mode="closed_form",
+        )
+    with pytest.raises(ValueError, match="hybrid_inner_steps"):
+        null_text_optimization(
+            fn, None, sched, traj, cond, uncond,
+            num_inference_steps=STEPS, null_text_mode="hybrid",
+            hybrid_inner_steps=0,
+        )
+
+
+def test_amortized_and_hybrid_recon_parity_band(sched, problem):
+    """The tentpole's quality contract: the closed-form amortized mode and
+    the joint-refinement hybrid must reconstruct within a few dB of the
+    optimize mode on the SAME CFG replay (and massively beat the raw
+    uncond), while taking 0 / K inner Adam steps instead of 10×."""
+    fn, x0, cond, uncond, traj = problem
+    seqs, stats = {}, {}
+    for mode in ("optimize", "amortized", "hybrid"):
+        seqs[mode], stats[mode] = null_text_optimization_fused(
+            fn, None, sched, traj, cond, uncond,
+            num_inference_steps=STEPS, guidance_scale=GUIDANCE,
+            null_text_mode=mode, donate=False, return_stats=True,
+        )
+    psnr = {m: _recon_psnr(sched, fn, traj, cond, uncond, s, x0)
+            for m, s in seqs.items()}
+    psnr_raw = _recon_psnr(sched, fn, traj, cond, uncond, None, x0)
+    for mode in ("amortized", "hybrid"):
+        assert psnr[mode] > psnr_raw + 6.0, (mode, psnr, psnr_raw)
+        # the parity band: the cheap modes stay within 3 dB of optimize
+        assert psnr[mode] > psnr["optimize"] - 3.0, (mode, psnr)
+    # the structural claims: zero inner Adam steps amortized, K=3 hybrid,
+    # and the loss record is the same reconstruction objective (finite,
+    # comparable across modes)
+    assert (np.asarray(stats["amortized"]["inner_steps"]) == 0).all()
+    assert (np.asarray(stats["hybrid"]["inner_steps"]) == 3).all()
+    for mode in ("amortized", "hybrid"):
+        assert np.isfinite(np.asarray(stats[mode]["final_loss"])).all()
+    # amortized really is the closed form: uncond := cond at every step
+    np.testing.assert_array_equal(
+        np.asarray(seqs["amortized"]),
+        np.broadcast_to(np.asarray(cond, np.float32),
+                        (STEPS,) + cond.shape),
+    )
+
+
+def test_new_modes_fused_matches_chunked(sched, problem):
+    """ISSUE 8 satellite: fused == chunked for the NEW modes too — the
+    amortized scan chunks like the optimize scan, and the hybrid joint
+    refinement is step-independent (absolute-index keys), so slicing the
+    step axis must not move numbers."""
+    fn, _, cond, uncond, traj = problem
+    for mode in ("amortized", "hybrid"):
+        chunked = null_text_optimization(
+            fn, None, sched, traj, cond, uncond,
+            num_inference_steps=STEPS, guidance_scale=GUIDANCE,
+            null_text_mode=mode, outer_chunk=3,
+            return_losses=True, return_inner_steps=True,
+        )
+        fused, fstats = null_text_optimization_fused(
+            fn, None, sched, traj, cond, uncond,
+            num_inference_steps=STEPS, guidance_scale=GUIDANCE,
+            null_text_mode=mode, donate=False, return_stats=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(fused), np.asarray(chunked[0]), rtol=2e-5, atol=2e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(fstats["final_loss"]), np.asarray(chunked[1]),
+            rtol=2e-5, atol=2e-7,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(fstats["inner_steps"]), np.asarray(chunked[2])
+        )
+
+
+def test_official_edit_mode_knob_matches_split_flow(sched, problem):
+    """official_edit(null_text_mode=...) must equal the split flow driven
+    by the same mode's embedding sequence — the fused official program and
+    the library path cannot drift per mode."""
+    fn, _, cond_src, uncond, traj = problem
+    cond_all = jnp.concatenate([cond_src, cond_src + 0.2], axis=0)
+    for mode in ("amortized", "hybrid"):
+        null_seq = null_text_optimization(
+            fn, None, sched, traj, cond_src, uncond,
+            num_inference_steps=STEPS, guidance_scale=GUIDANCE,
+            null_text_mode=mode,
+        )
+        split = edit_sample(
+            fn, None, sched, traj[-1], cond_all, uncond[0],
+            num_inference_steps=STEPS, guidance_scale=GUIDANCE,
+            source_uses_cfg=True, null_uncond_embeddings=null_seq,
+        )
+        fused, stats = official_edit(
+            fn, None, sched, traj, cond_all, uncond[0],
+            num_inference_steps=STEPS, guidance_scale=GUIDANCE,
+            null_text_mode=mode, donate=False, return_null_stats=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(fused), np.asarray(split), rtol=2e-5, atol=2e-6
+        )
+        expected_inner = 0 if mode == "amortized" else 3
+        assert (np.asarray(stats["inner_steps"]) == expected_inner).all()
+
+
+def test_cheap_modes_pass_quality_rules_via_obs_diff(
+    sched, problem, tmp_path
+):
+    """The ISSUE 8 acceptance gate, end to end: write the optimize-mode
+    reconstruction's quality record as the baseline ledger and each cheap
+    mode's (amortized, hybrid) as the new run, then tools/obs_diff.py must
+    exit 0 — the substitutes' reconstruction parity clears QUALITY_RULES
+    machine-checkably (and a fabricated recon drop exits 1, proving the
+    gate has teeth)."""
+    import importlib.util
+
+    from videop2p_tpu.obs import RunLedger
+    from videop2p_tpu.obs.quality import edit_quality_record
+
+    fn, x0, cond, uncond, traj = problem
+
+    def recon01(null_seq):
+        out = edit_sample(
+            fn, None, sched, traj[-1], cond, uncond[0],
+            num_inference_steps=STEPS, guidance_scale=GUIDANCE,
+            source_uses_cfg=True, null_uncond_embeddings=null_seq,
+        )
+        lo, hi = float(jnp.min(x0)), float(jnp.max(x0))
+        to01 = lambda v: (jnp.clip(v, lo, hi) - lo) / max(hi - lo, 1e-9)  # noqa: E731
+        return np.asarray(to01(out[0])), np.asarray(to01(x0[0]))
+
+    ledgers = {}
+    for mode in ("optimize", "amortized", "hybrid"):
+        null_seq = null_text_optimization(
+            fn, None, sched, traj, cond, uncond,
+            num_inference_steps=STEPS, guidance_scale=GUIDANCE,
+            null_text_mode=mode,
+        )
+        recon, src = recon01(null_seq)
+        summary, _ = edit_quality_record(src, recon, recon)
+        path = str(tmp_path / f"{mode}.jsonl")
+        with RunLedger(path) as led:
+            led.event("quality", program="edit_quality", **summary)
+        ledgers[mode] = path
+
+    spec = importlib.util.spec_from_file_location(
+        "obs_diff_under_null_test",
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     "tools", "obs_diff.py"),
+    )
+    obs_diff = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(obs_diff)
+    for mode in ("amortized", "hybrid"):
+        assert obs_diff.main(
+            ["obs_diff.py", ledgers["optimize"], ledgers[mode]]
+        ) == 0, mode
+    # teeth: a fabricated recon drop far below the BASELINE must regress
+    # (exit 1) — the rule gates against the optimize run's value
+    import json as _json
+
+    dropped = str(tmp_path / "dropped.jsonl")
+    with open(ledgers["amortized"]) as f, open(dropped, "w") as g:
+        for line in f:
+            e = _json.loads(line)
+            if e.get("event") == "quality":
+                e["recon_psnr"] = float(e["recon_psnr"]) - 40.0
+            g.write(_json.dumps(e) + "\n")
+    assert obs_diff.main(
+        ["obs_diff.py", ledgers["optimize"], dropped]
+    ) == 1
+
+
 def test_official_edit_matches_split_flow(sched, problem):
     """official_edit (null-text + controlled CFG edit as ONE program) must
     equal the split flow that surfaces the embeddings on host."""
@@ -264,15 +452,17 @@ def test_official_e2e_records_schema_off_tpu():
 
     keys = {
         "official_edit_e2e_fp32_s", "official_edit_e2e_mixed_s",
+        "official_edit_e2e_amortized_s", "official_edit_e2e_hybrid_s",
         "null_text_inner_step_fp32_ms", "null_text_inner_step_mixed_ms",
         "official_vs_baseline_fp32", "official_vs_baseline_mixed",
+        "official_vs_baseline_amortized", "official_vs_baseline_hybrid",
     }
     # off-TPU: nothing measured — keys present, every value null
     empty = bench.official_e2e_records(None, None)
     assert set(empty) == keys
     assert all(v is None for v in empty.values())
 
-    # one variant measured: its triple is populated, the other stays null
+    # one variant measured: its triple is populated, the others stay null
     partial = bench.official_e2e_records(
         10.0, 14.0, null_mixed_s=60.0, inner_steps=150
     )
@@ -281,12 +471,55 @@ def test_official_e2e_records_schema_off_tpu():
     assert partial["official_vs_baseline_mixed"] == round(600.0 / 84.0, 2)
     assert partial["official_edit_e2e_fp32_s"] is None
     assert partial["null_text_inner_step_fp32_ms"] is None
+    assert partial["official_edit_e2e_amortized_s"] is None
+    assert partial["official_vs_baseline_hybrid"] is None
 
     both = bench.official_e2e_records(
-        10.0, 14.0, null_fp32_s=203.0, null_mixed_s=60.0, inner_steps=150
+        10.0, 14.0, null_fp32_s=203.0, null_mixed_s=60.0,
+        null_amortized_s=3.0, null_hybrid_s=12.0, inner_steps=150,
     )
     assert both["official_edit_e2e_fp32_s"] == 227.0
     assert both["official_vs_baseline_fp32"] == round(600.0 / 227.0, 2)
+    assert both["official_edit_e2e_amortized_s"] == 27.0
+    assert both["official_vs_baseline_amortized"] == round(600.0 / 27.0, 2)
+    assert both["official_edit_e2e_hybrid_s"] == 36.0
+
+
+def test_null_text_flop_records_guarantee_3x_reduction():
+    """The per-mode flop accounting (bench.null_text_flop_records): built
+    from straight-line unit analyses with the disclosed loop structure, at
+    the official defaults (I=10, K=3) the hybrid reduction is ≥3× for ANY
+    inner/forward cost ratio ≥1 and the amortized reduction is far larger."""
+    spec = importlib.util.spec_from_file_location(
+        "bench_flops_under_test",
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     "bench.py"),
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    for inner_over_fwd in (1.0, 2.0, 3.0, 10.0):
+        f = 1e9
+        rec = bench.null_text_flop_records(f, inner_over_fwd * f)
+        assert rec["null_text_flops_reduction_amortized"] >= 3.0
+        assert rec["null_text_flops_reduction_hybrid"] >= 3.0, rec
+        # the totals follow the disclosed formulas exactly
+        assert rec["null_text_total_flops_amortized"] == 50 * f
+        assert rec["null_text_total_flops_optimize"] == 50 * (
+            2 * f + 10 * inner_over_fwd * f
+        )
+        assert rec["null_text_total_flops_hybrid"] == 50 * (
+            f + 3 * inner_over_fwd * f
+        )
+    # the record is schema-stable (bench_details.json keys)
+    assert {
+        "null_text_unit_fwd_flops", "null_text_unit_inner_flops",
+        "null_text_flop_params",
+        "null_text_total_flops_optimize", "null_text_total_flops_amortized",
+        "null_text_total_flops_hybrid",
+        "null_text_flops_reduction_amortized",
+        "null_text_flops_reduction_hybrid",
+    } == set(bench.null_text_flop_records(1.0, 1.0))
 
 
 # ------------------------------------------- cached.py float8 upcast --

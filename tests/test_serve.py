@@ -370,7 +370,7 @@ def engine(tmp_path_factory):
         keep_videos=True,
     )
     eng.warm(("a rabbit is jumping", "a origami rabbit is jumping"),
-             batch_sizes=(2,))
+             batch_sizes=(2,), step_buckets=(1,))
     yield eng
     eng.close()
 
@@ -434,6 +434,41 @@ def test_engine_batches_concurrent_compatible_requests(engine):
     assert all(rec["padded_size"] == 4 for rec in recs)
 
 
+def test_engine_per_request_steps_runs_few_step_fast_path(engine):
+    """ISSUE 8: a warmed few-step request runs the timestep-subset fast
+    path from the SAME inversion products — store hit, source replay still
+    exact (src_err == 0.0), output genuinely different from the full-step
+    edit."""
+    r_base = engine.submit(_rabbit_request())
+    rec_base = engine.result(r_base, wait_s=300.0)
+    assert rec_base["status"] == "done", rec_base.get("error")
+    rid = engine.submit(_rabbit_request(steps=1))
+    rec = engine.result(rid, wait_s=300.0)
+    assert rec["status"] == "done", rec.get("error")
+    assert rec["steps"] == 1
+    assert rec["store_hit"] is True
+    assert rec["src_err"] == 0.0
+    assert not np.array_equal(engine.videos(rid), engine.videos(r_base))
+
+
+def test_engine_rejects_unwarmed_steps_with_warm_list(engine):
+    """ISSUE 8 satellite: per-request `steps` outside the warmed buckets
+    is rejected AT SUBMIT with the warm list — unknown step geometry must
+    not silently compile cold mid-serve (the HTTP layer maps this
+    ValueError to a 400)."""
+    from videop2p_tpu.serve import EditRequest
+
+    with pytest.raises(ValueError, match=r"warmed: \[1, 2\]"):
+        engine.submit(_rabbit_request(steps=3))
+    # the request-shape validation catches non-positive steps before the
+    # bucket check
+    with pytest.raises(ValueError, match="positive int"):
+        EditRequest(image_path="x", prompt="a", prompts=["a", "b"],
+                    steps=0).validate()
+    # healthz/warm summary advertises the admitted buckets
+    assert engine.programs.warmed["steps"] == [1, 2]
+
+
 def test_engine_metrics_report_reservoir_latency(engine):
     m = engine.metrics()
     lat = m["request_latency"]
@@ -474,11 +509,14 @@ def test_http_roundtrip_and_metrics(engine):
         assert rec_srv["status"] == "done" and rec_srv["id"] == rec["id"]
         metrics = client.metrics()
         assert metrics["request_latency"]["blocked_p99_s"] > 0.0
-        # error surfaces: unknown id -> 404, malformed request -> 400
+        # error surfaces: unknown id -> 404, malformed request -> 400,
+        # unwarmed per-request steps -> 400 carrying the warm list
         with pytest.raises(RuntimeError, match="404"):
             client.poll("feedfacefeed")
         with pytest.raises(RuntimeError, match="400"):
             client.submit({"prompt": "a", "bogus": True})
+        with pytest.raises(RuntimeError, match="400"):
+            client.submit({**_rabbit_request().to_dict(), "steps": 37})
     finally:
         server.close()
     assert not engine_available(server.url)

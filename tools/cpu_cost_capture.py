@@ -129,10 +129,59 @@ def build_abstract_programs(frames: int, steps: int, tiny: bool):
         )[1]
     )
     xt_sds = jax.ShapeDtypeStruct(x0.shape, x0.dtype)
+
+    # straight-line null-text UNIT programs (bench.null_text_flop_records):
+    # one UNet forward and one inner Adam iteration (loss forward + backward
+    # + update). NO loops — XLA's static cost_analysis counts scan/while
+    # bodies once, so only loop-free programs have static counts equal to
+    # their true flops; the per-mode totals (optimize / amortized / hybrid)
+    # follow analytically from these units and the disclosed loop structure.
+    # The grad program uses the SAME per-block remat the real null-text
+    # optimization runs with (its recompute flops are part of the real cost).
+    import optax
+
+    if tiny:
+        cfg_r = type(cfg)(**{**cfg.__dict__, "gradient_checkpointing": True})
+    else:
+        cfg_r = UNet3DConfig.sd15(frame_attention="chunked", group_norm="xla",
+                                  gradient_checkpointing=True)
+    fn_r = make_unet_fn(UNet3DConditionModel(config=cfg_r, dtype=jnp.bfloat16))
+
+    lat_f32 = jax.ShapeDtypeStruct((1, frames, lat, lat, 4), jnp.float32)
+    t_sds = jax.ShapeDtypeStruct((), jnp.int32)
+    u_sds = jax.ShapeDtypeStruct((1, 77, ctx_dim), jnp.float32)
+    adam = optax.adam(1.0)
+
+    def unit_fwd(p, x, t, text):
+        eps, _ = fn_r(p, x, t, text, None)
+        return eps.astype(jnp.float32)
+
+    def unit_inner(p, u, lat_cur, t, eps_cond, latent_prev):
+        opt_state = adam.init(u)
+
+        def loss_fn(u_):
+            eps_u, _ = fn_r(p, lat_cur, t, u_, None)
+            eps = eps_u.astype(jnp.float32) + 7.5 * (
+                eps_cond - eps_u.astype(jnp.float32)
+            )
+            prev_rec = sched.prev_step(eps, t, lat_cur, steps)
+            return jnp.mean((prev_rec - latent_prev) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(u)
+        updates, opt_state = adam.update(grads, opt_state, u)
+        return optax.apply_updates(u, updates), loss
+
     return {
         "invert_captured": (invert_captured, (params, x0, cond_src)),
         "edit_cached": (edit_cached, (params, xt_sds, cond, uncond, cached_sds)),
         "e2e_cached": (e2e_cached, (params, x0, cond_src, cond, uncond)),
+        "null_text_unit_fwd": (
+            jax.jit(unit_fwd), (params, lat_f32, t_sds, u_sds)
+        ),
+        "null_text_unit_inner": (
+            jax.jit(unit_inner),
+            (params, u_sds, lat_f32, t_sds, lat_f32, lat_f32),
+        ),
     }
 
 

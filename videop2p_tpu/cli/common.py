@@ -203,6 +203,19 @@ def add_null_text_args(parser: argparse.ArgumentParser) -> None:
              "outer scan into N-step host-dispatched chunks (the TPU "
              "execution-watchdog fallback for multi-minute fp32 programs)",
     )
+    parser.add_argument(
+        "--null_text_mode", type=str, default=None,
+        choices=["optimize", "amortized", "hybrid"],
+        help="how the per-step unconditional embedding is produced: "
+             "optimize (default — the reference's per-step inner Adam "
+             "loop), amortized (closed-form negative-prompt-inversion "
+             "substitute: zero inner Adam steps, one forward per outer "
+             "step — ~90%% of the official-mode wall-clock is this inner "
+             "loop), or hybrid (amortized seed + <=3 refinement steps "
+             "batched jointly across all outer steps). Reconstruction "
+             "parity is pinned in tests and gated by the quality rules "
+             "(tools/obs_diff.py)",
+    )
 
 
 def add_obs_args(parser: argparse.ArgumentParser) -> None:

@@ -240,6 +240,13 @@ def main(
     # forwards in bf16 (a bf16-compute clone of the UNet over the same
     # params) with fp32 scheduler/Adam/loss islands (pipelines/inversion.py)
     null_text_precision: str = "fp32",
+    # how the per-step uncond embedding is produced (pipelines/inversion.py):
+    # "optimize" = the reference's per-step inner Adam loop; "amortized" =
+    # closed-form negative-prompt-inversion substitute (zero inner Adam
+    # steps — the structural attack on the 91%-of-e2e null-text phase);
+    # "hybrid" = amortized seed + K<=3 refinement steps batched jointly
+    # across all outer steps. Parity gated by the quality rules.
+    null_text_mode: str = "optimize",
     # 0 = the fused single-dispatch donated-trajectory program;
     # N>0 = N-step host-dispatched chunks (execution-watchdog fallback)
     null_text_chunk: int = 0,
@@ -331,7 +338,8 @@ def main(
         ledger=ledger, mesh=mesh,
         meta={"cli": "run_videop2p", "fast": fast, "save_name": save_name,
               "prompt": prompt, "prompts": list(prompts),
-              "null_text_precision": null_text_precision},
+              "null_text_precision": null_text_precision,
+              "null_text_mode": null_text_mode},
         telemetry=telemetry, attn_maps=attn_maps, quality=quality,
         report=report, device_telemetry=device_telemetry, latency=latency,
         trace_analysis=trace_analysis,
@@ -533,11 +541,12 @@ def main(
     # consult the persisted products only once the cached-source decision is
     # FINAL (incl. the maps-budget fallback): a budget-forced live run is
     # live on every invocation, so reuse keeps its output-identity guarantee
-    # the persisted null embeddings are precision-variant products: a mixed
-    # run must never silently reuse fp32 embeddings (or vice versa)
+    # the persisted null embeddings are precision- AND mode-variant
+    # products: a mixed/amortized run must never silently reuse fp32 or
+    # optimized embeddings (or vice versa)
     null_tag = f"_i{num_inner_steps}" + (
         "_mixed" if null_text_precision == "mixed" else ""
-    )
+    ) + ("" if null_text_mode == "optimize" else f"_{null_text_mode}")
     reused = (
         load_persisted_inversion(
             store_root, inv_key, want_null=not fast,
@@ -687,12 +696,17 @@ def main(
             guidance_scale=GUIDANCE_SCALE,
             num_inner_steps=num_inner_steps,
             null_text_precision=null_text_precision,
+            null_text_mode=null_text_mode,
             dependent_weight=dep_w,
             dependent_sampler=sampler if dep_w > 0 else None,
             key=nk,
         )
+        # phase unit count: inner Adam steps for optimize/hybrid (K=3), one
+        # forward per outer step for the closed-form amortized mode
+        per_outer = {"optimize": num_inner_steps, "hybrid": 3,
+                     "amortized": 1}.get(null_text_mode, num_inner_steps)
         with phase_timer("null_text_optimization",
-                         count=NUM_DDIM_STEPS * num_inner_steps,
+                         count=NUM_DDIM_STEPS * per_outer,
                          unit="inner-step"), \
              program_label("null_text_fused" if null_text_chunk == 0
                            else "null_text_chunked"):
@@ -720,9 +734,10 @@ def main(
             null_embeddings = jax.block_until_ready(null_embeddings)
         if null_stats is not None and "inner_steps" in null_stats:
             inner_total = int(np.asarray(null_stats["inner_steps"]).sum())
-            print(f"[p2p] null-text ({null_text_precision}): {inner_total} "
-                  f"inner Adam steps across {NUM_DDIM_STEPS} outer steps, "
-                  f"final loss {float(np.asarray(null_stats['final_loss'])[-1]):.3e}")
+            print(f"[p2p] null-text ({null_text_mode}/{null_text_precision}): "
+                  f"{inner_total} inner Adam steps across {NUM_DDIM_STEPS} "
+                  f"outer steps, final loss "
+                  f"{float(np.asarray(null_stats['final_loss'])[-1]):.3e}")
         if run_ledger is not None and null_stats is not None:
             from videop2p_tpu.obs import decode_null_text_stats, summarize_step_stats
 
@@ -884,6 +899,8 @@ if __name__ == "__main__":
         cfg["null_text_precision"] = args.null_text_precision
     if args.null_text_chunk is not None:
         cfg["null_text_chunk"] = args.null_text_chunk
+    if args.null_text_mode is not None:
+        cfg["null_text_mode"] = args.null_text_mode
     args.mesh = args.mesh or cfg.pop("mesh", None)
     main(
         **cfg,

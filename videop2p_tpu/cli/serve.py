@@ -60,6 +60,11 @@ def build_parser() -> argparse.ArgumentParser:
                     default=["a video", "an edited video"],
                     help="source/edit prompt pair whose controller structure "
                          "the warm-up compiles for")
+    ap.add_argument("--step_buckets", type=int, nargs="*", default=[],
+                    help="additional few-step edit variants to warm (e.g. "
+                         "20 8): exact timestep subsets of --steps served "
+                         "from the SAME inversion products; per-request "
+                         "'steps' outside the warmed buckets is a 400")
     return ap
 
 
@@ -91,9 +96,11 @@ def main(argv=None) -> int:
     if not args.no_warm:
         print(f"[serve] warming programs (spec {engine.spec.fingerprint()})...")
         info = engine.warm(tuple(args.warm_prompts),
-                           batch_sizes=(min(2, args.max_batch),))
+                           batch_sizes=(min(2, args.max_batch),),
+                           step_buckets=tuple(args.step_buckets))
         print(f"[serve] warm in {info['seconds']}s "
-              f"(batch sizes {info['batch_sizes']})")
+              f"(batch sizes {info['batch_sizes']}, "
+              f"step buckets {info['steps']})")
     server = make_server(engine, host=args.host, port=args.port)
     print(f"[serve] listening on {server.url}  "
           f"(ledger: {engine.ledger.path})")

@@ -159,6 +159,48 @@ class DDIMScheduler(struct.PyTreeNode):
         ts = (np.arange(num_inference_steps) * step_ratio).round()[::-1].astype(np.int64)
         return ts + self.steps_offset
 
+    def subset_positions(self, base_steps: int, steps: int) -> np.ndarray:
+        """Positions into the DESCENDING ``timesteps(base_steps)`` grid for a
+        ``steps``-step walk over an EXACT subset of the base timesteps.
+
+        The cached fast path's step-reduction seam: a ``base_steps``
+        inversion trajectory holds a latent at every base grid point, so an
+        edit that visits only a subset of those timesteps can still read the
+        source replay (and the captured maps) exactly — no re-inversion, no
+        interpolation. Leading-spaced (``floor(j·base/steps)``), so position
+        0 (x_T) is always included and the subset walk starts from the same
+        x_T the base walk would.
+        """
+        base_steps, steps = int(base_steps), int(steps)
+        if not 1 <= steps <= base_steps:
+            raise ValueError(
+                f"steps {steps} must be in [1, base_steps={base_steps}]"
+            )
+        return np.floor(
+            np.arange(steps) * (base_steps / steps)
+        ).astype(np.int64)
+
+    def subset_schedule(
+        self, base_steps: int, steps: int
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """``(positions, timesteps, prev_timesteps)`` for a ``steps``-step
+        walk over an exact subset of the ``base_steps`` grid.
+
+        ``prev_timesteps[j]`` is where step *j* lands: the next subset
+        timestep, and for the last step the base walk's own terminal target
+        (``timesteps(base)[-1] − ratio`` < 0 → ``final_alpha_cumprod``), so
+        every subset walk ends at the same "clean" ᾱ as the base walk. With
+        ``steps == base_steps`` this reproduces the uniform rule exactly —
+        ``prev_timesteps == timesteps − ratio`` — so passing these through
+        ``step(..., prev_timestep=...)`` changes nothing at full step count.
+        """
+        positions = self.subset_positions(base_steps, steps)
+        base_ts = self.timesteps(base_steps)
+        ts = base_ts[positions]
+        ratio = self.num_train_timesteps // base_steps
+        prev = np.concatenate([ts[1:], [base_ts[-1] - ratio]])
+        return positions, ts, prev
+
     # ------------------------------------------------------------------ #
     # shared math
     # ------------------------------------------------------------------ #
@@ -213,6 +255,7 @@ class DDIMScheduler(struct.PyTreeNode):
         eta: float = 0.0,
         variance_noise: Optional[jax.Array] = None,
         use_clipped_model_output: bool = False,
+        prev_timestep: Optional[jax.Array] = None,
     ) -> Tuple[jax.Array, jax.Array]:
         """One reverse DDIM step x_t → x_{t-Δ} (dependent_ddim.py:212-341).
 
@@ -222,9 +265,14 @@ class DDIMScheduler(struct.PyTreeNode):
         dependent_ddim.py:320-334). Runs as an fp32 island: inputs are cast
         to float32 and the returned samples are float32 regardless of the
         caller's trace dtype.
+
+        ``prev_timestep``: explicit landing timestep for non-uniform
+        (timestep-subset, :meth:`subset_schedule`) walks; the default is the
+        uniform rule ``t − num_train/num_inference_steps``.
         """
         model_output, sample = _f32(model_output, sample)
-        prev_timestep = timestep - self.num_train_timesteps // num_inference_steps
+        if prev_timestep is None:
+            prev_timestep = timestep - self.num_train_timesteps // num_inference_steps
 
         alpha_prod_t = self._alpha_prod(timestep)
         alpha_prod_t_prev = self._alpha_prod(prev_timestep)
@@ -261,12 +309,16 @@ class DDIMScheduler(struct.PyTreeNode):
         timestep: jax.Array,
         sample: jax.Array,
         num_inference_steps: int,
+        *,
+        prev_timestep: Optional[jax.Array] = None,
     ) -> jax.Array:
         """Deterministic (η=0, no clipping) x_t → x_{t-Δ}; the form used inside
         null-text optimization (run_videop2p.py:445-453). An fp32 island —
-        usable from a bf16 trace without losing trajectory fidelity."""
+        usable from a bf16 trace without losing trajectory fidelity.
+        ``prev_timestep`` overrides the uniform spacing rule (subset walks)."""
         model_output, sample = _f32(model_output, sample)
-        prev_timestep = timestep - self.num_train_timesteps // num_inference_steps
+        if prev_timestep is None:
+            prev_timestep = timestep - self.num_train_timesteps // num_inference_steps
         alpha_prod_t = self._alpha_prod(timestep)
         alpha_prod_t_prev = self._alpha_prod(prev_timestep)
         beta_prod_t = 1.0 - alpha_prod_t

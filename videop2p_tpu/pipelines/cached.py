@@ -44,10 +44,12 @@ from flax import struct
 __all__ = [
     "CachedSource",
     "capture_windows",
+    "check_subset_windows",
     "filter_site_tree",
     "merge_site_trees",
     "slice_site_tree",
     "tree_bytes",
+    "validate_step_positions",
 ]
 
 
@@ -64,6 +66,60 @@ def capture_windows(ctx, num_steps: int) -> Tuple[int, Tuple[int, int]]:
     active = (cra != 0).any(axis=tuple(range(1, cra.ndim)))
     cross_len = int(active.nonzero()[0].max()) + 1 if active.any() else 0
     return cross_len, ctx.self_replace_range
+
+
+def validate_step_positions(positions, base_steps: int):
+    """Normalize/validate a timestep-subset walk's positions into the
+    ``base_steps`` edit-order grid (``DDIMScheduler.subset_positions`` is
+    the canonical producer). Strictly increasing, starting at 0 (the
+    subset walk must begin at the same x_T the capture did), ending inside
+    the base grid. Returns an int64 numpy array."""
+    import numpy as np
+
+    pos = np.asarray(positions, dtype=np.int64)
+    if pos.ndim != 1 or pos.size < 1:
+        raise ValueError(f"step_positions must be a 1-D sequence, got {positions!r}")
+    if pos[0] != 0:
+        raise ValueError(
+            f"step_positions must start at 0 (the capture's x_T), got {pos[0]}"
+        )
+    if pos.size > 1 and (np.diff(pos) <= 0).any():
+        raise ValueError(f"step_positions must be strictly increasing: {pos.tolist()}")
+    if pos[-1] >= base_steps:
+        raise ValueError(
+            f"step_positions reach {pos[-1]} but the capture covers "
+            f"[0, {base_steps})"
+        )
+    return pos
+
+
+def check_subset_windows(ctx, cached, positions, num_steps: int) -> None:
+    """Host-side gate-coverage check for a timestep-subset edit over a
+    ``cached`` capture: every subset step whose controller gate is OPEN
+    must map (via ``positions``) inside the captured base window — a step
+    outside it would silently read a clamped/stale base map. Requires a
+    CONCRETE controller (call before tracing; the serving layer does)."""
+    import numpy as np
+
+    if ctx is None or ctx.kind == "empty":
+        return
+    cross_len_sub, (lo_s, hi_s) = capture_windows(ctx, num_steps)
+    pos = np.asarray(positions)
+    if cross_len_sub > 0:
+        mapped = pos[:cross_len_sub]
+        if cached.cross_len <= 0 or int(mapped.max()) >= cached.cross_len:
+            raise ValueError(
+                f"subset cross window maps to base steps {mapped.tolist()} "
+                f"outside the captured cross window [0, {cached.cross_len})"
+            )
+    if hi_s > lo_s:
+        mapped = pos[lo_s:hi_s]
+        lo_b, hi_b = cached.self_window
+        if mapped.size and (int(mapped.min()) < lo_b or int(mapped.max()) >= hi_b):
+            raise ValueError(
+                f"subset self window maps to base steps {mapped.tolist()} "
+                f"outside the captured self window [{lo_b}, {hi_b})"
+            )
 
 
 def filter_site_tree(tree: Dict[str, Any], site_name: str) -> Dict[str, Any]:

@@ -62,6 +62,17 @@ _FUSED_PROGRAM_CACHE: dict = {}
 _FUSED_PROGRAM_CACHE_MAX = 4
 
 _NULL_TEXT_PRECISIONS = ("fp32", "mixed")
+# how the per-step unconditional embedding is produced:
+#   "optimize"  — the reference's per-step inner Adam loop (Mokady et al.);
+#   "amortized" — closed-form negative-prompt-inversion substitute
+#                 (Miyake et al., 2023): uncond := cond, under which the CFG
+#                 combine collapses to the conditional prediction and the
+#                 denoise replays the inversion trajectory with ZERO inner
+#                 Adam steps — one forward per outer step, one fused scan;
+#   "hybrid"    — amortized seed + K (hybrid_inner_steps) refinement Adam
+#                 steps run JOINTLY across all outer steps as one batched
+#                 program (vs 50×num_inner_steps sequential inner steps).
+_NULL_TEXT_MODES = ("optimize", "amortized", "hybrid")
 
 
 def _cache_put(cache: dict, cache_max: int, key, value) -> None:
@@ -339,6 +350,8 @@ def null_text_optimization(
     num_inner_steps: int = 10,
     epsilon: float = 1e-5,
     null_text_precision: str = "fp32",
+    null_text_mode: str = "optimize",
+    hybrid_inner_steps: int = 3,
     dependent_weight: float = 0.0,
     dependent_sampler: Optional[DependentNoiseSampler] = None,
     key: Optional[jax.Array] = None,
@@ -403,11 +416,46 @@ def null_text_optimization(
     Only valid OUTSIDE jit (the function then jits its own chunk scan).
     For the single-dispatch donated-buffer variant see
     :func:`null_text_optimization_fused`.
+
+    ``null_text_mode``: how the embedding sequence is produced.
+
+      * ``"optimize"`` (default) — the reference's per-step inner Adam loop,
+        exactly as documented above (every other knob applies unchanged).
+      * ``"amortized"`` — the closed-form negative-prompt-inversion
+        substitute (Miyake et al., 2023): the unconditional embedding is set
+        to the SOURCE conditional embedding at every step, under which the
+        CFG combine ``ε_u + g·(ε_c − ε_u)`` collapses to ``ε_c`` and the
+        denoise replays the inversion trajectory to NPI accuracy with zero
+        inner Adam steps. One forward per outer step (vs ``2 +
+        3·num_inner_steps`` forward-equivalents), one fused scan; the
+        returned ``final_loss`` per step is the SAME reconstruction
+        objective the optimizer would have minimized — the direct parity
+        record. ``num_inner_steps``/``epsilon``/``early_stop`` are inert;
+        ``inner_steps`` reads 0 everywhere.
+      * ``"hybrid"`` — amortized seed + ``hybrid_inner_steps`` (K ≤ 3
+        recommended) refinement Adam steps run JOINTLY across all outer
+        steps: each step optimizes its embedding against the RECORDED
+        trajectory latents (the amortized fixed point), so the 50 outer
+        optimizations lose their sequential dependence and batch into one
+        K-iteration program — K sequential gradient phases instead of
+        ``50 × num_inner_steps``. ``final_loss`` is each step's last
+        pre-update loss (the ``"optimize"`` convention); ``inner_steps``
+        reads K everywhere (no early stop — the batch is joint).
+
+    Both non-default modes trade a bounded reconstruction-accuracy delta
+    (pinned as a PSNR band in tests/test_null_text_precision.py and gated
+    by the quality rules, tools/obs_diff.py) for a ≥3× inner-loop flop
+    reduction; ``outer_chunk`` composes with every mode (chunked ==
+    unchunked, per-step math identical).
     """
     if null_text_precision not in _NULL_TEXT_PRECISIONS:
         raise ValueError(
             f"null_text_precision {null_text_precision!r} not in "
             f"{_NULL_TEXT_PRECISIONS}"
+        )
+    if null_text_mode not in _NULL_TEXT_MODES:
+        raise ValueError(
+            f"null_text_mode {null_text_mode!r} not in {_NULL_TEXT_MODES}"
         )
     if dependent_weight > 0.0 and dependent_sampler is None:
         raise ValueError("dependent_weight > 0 requires dependent_sampler")
@@ -499,6 +547,32 @@ def null_text_optimization(
             ys += (latent_stats(latent_cur),)
         return (latent_cur, uncond, key, params, cond_embedding), ys
 
+    def outer_amortized(carry, xs):
+        # negative-prompt-inversion closed form: uncond := cond, so the CFG
+        # combine collapses to the conditional prediction — ONE forward per
+        # outer step, zero inner Adam steps. The per-step loss is the same
+        # reconstruction objective the optimizer minimizes (the replay's
+        # residual against the recorded trajectory), so the record stays
+        # directly comparable to the "optimize" mode's final_loss.
+        latent_cur, _uncond, key, params, cond_embedding = carry
+        t, latent_prev, _lr, _thresh = xs
+        key, k_fu, k_fc = jax.random.split(key, 3)
+        eps_cond_raw = fwd(params, latent_cur, t, cond_embedding)
+        uncond_out = cond_embedding.astype(jnp.float32)
+        # dependent mode: the CFG halves draw independent fresh noise, the
+        # same structure as the optimize mode's final advance
+        eps_uncond = blend(eps_cond_raw, k_fu)
+        eps_c = blend(eps_cond_raw, k_fc)
+        eps = eps_uncond + guidance_scale * (eps_c - eps_uncond)
+        prev_rec = scheduler.prev_step(eps, t, latent_cur, num_inference_steps)
+        final_loss = jnp.mean((prev_rec - latent_prev) ** 2)
+        ys = (uncond_out, final_loss, jnp.asarray(0, jnp.int32))
+        if telemetry:
+            ys += (latent_stats(prev_rec),)
+        return (prev_rec, uncond_out, key, params, cond_embedding), ys
+
+    outer_fn = outer if null_text_mode == "optimize" else outer_amortized
+
     x_t = trajectory[-1]
     xs = (timesteps, prev_seq, lr_seq, thresh_seq)
 
@@ -508,7 +582,7 @@ def null_text_optimization(
         # carry-out), which for SD-scale params tips a 16 GB chip into OOM
         def body(c, x):
             lat, unc, k = c
-            (lat, unc, k, _, _), y = outer((lat, unc, k, p, cond), x)
+            (lat, unc, k, _, _), y = outer_fn((lat, unc, k, p, cond), x)
             return (lat, unc, k), y
 
         return body
@@ -523,6 +597,110 @@ def null_text_optimization(
             out += (tel,)
         return out if len(out) > 1 else out[0]
 
+    if null_text_mode == "hybrid":
+        K = int(hybrid_inner_steps)
+        if K < 1:
+            raise ValueError(f"hybrid_inner_steps must be >= 1, got {K}")
+        # every step optimizes against the RECORDED trajectory latents (the
+        # amortized fixed point, where the CFG replay already tracks the
+        # trajectory), so the outer steps lose the sequential dependence the
+        # "optimize" mode carries through latent_cur: K gradient phases over
+        # a step-batched embedding replace N×num_inner_steps sequential
+        # inner steps. Per-step math is chunk-invariant (absolute-index
+        # keys, independent steps), so chunked == unchunked exactly.
+        lat_cur_seq = trajectory[::-1][:-1]  # latent entering outer step i
+        step_keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(steps)
+
+        def hybrid_chunk_fn(p, cond, chunk_xs):
+            t_c, lat_c, prev_c, lr_c, k_c = chunk_xs
+            ks = jax.vmap(lambda k: jax.random.split(k, 2))(k_c)
+
+            def cond_eps(lat, t, k):
+                return blend(jax.lax.stop_gradient(fwd(p, lat, t, cond)), k)
+
+            eps_cond = jax.vmap(cond_eps)(lat_c, t_c, ks[:, 0])
+            # amortized seed: uncond := cond at every step
+            u0 = jnp.broadcast_to(
+                cond.astype(jnp.float32), (t_c.shape[0],) + cond.shape
+            )
+            opt_state = adam.init(u0)
+
+            def loss_one(u, lat, t, ec, lp, k):
+                eps_uncond = blend(fwd(p, lat, t, u), k)
+                eps = eps_uncond + guidance_scale * (ec - eps_uncond)
+                prev_rec = scheduler.prev_step(
+                    eps, t, lat, num_inference_steps
+                )
+                return jnp.mean((prev_rec - lp) ** 2), prev_rec
+
+            grad_one = jax.value_and_grad(loss_one, has_aux=True)
+
+            def iter_body(carry, _):
+                u_seq, opt_state, kseq = carry
+                kpair = jax.vmap(lambda k: jax.random.split(k, 2))(kseq)
+                (losses, prev_recs), grads = jax.vmap(grad_one)(
+                    u_seq, lat_c, t_c, eps_cond, prev_c, kpair[:, 0]
+                )
+                updates, opt_state = adam.update(grads, opt_state, u_seq)
+                u_seq = optax.apply_updates(
+                    u_seq,
+                    jax.tree.map(
+                        lambda g: lr_c[:, None, None, None] * g, updates
+                    ),
+                )
+                ys = (losses,)
+                if telemetry:
+                    # scalars only in the iteration ys — stacking prev_recs
+                    # across K would hold K extra trajectories in HBM
+                    ys += (jax.vmap(latent_stats)(prev_recs),)
+                return (u_seq, opt_state, kpair[:, 1]), ys
+
+            (u_seq, _, _), it_ys = jax.lax.scan(
+                iter_body, (u0, opt_state, ks[:, 1]), None, length=K
+            )
+            # the "optimize" convention: final_loss is the last executed
+            # iteration's pre-update loss
+            outs = (
+                u_seq,
+                it_ys[0][-1],
+                jnp.full((t_c.shape[0],), K, jnp.int32),
+            )
+            if telemetry:
+                outs += (jax.tree.map(lambda a: a[-1], it_ys[1]),)
+            return outs
+
+        hybrid_xs = (timesteps, lat_cur_seq, prev_seq, lr_seq, step_keys)
+        if not outer_chunk or outer_chunk >= num_inference_steps:
+            return pack(*hybrid_chunk_fn(params, cond_embedding, hybrid_xs))
+        cache_key = (
+            "hybrid", unet_fn, id(scheduler), id(dependent_sampler),
+            float(guidance_scale), K, int(num_inference_steps),
+            float(dependent_weight), null_text_precision, bool(telemetry),
+        )
+        chunk_prog = _CHUNK_SCAN_CACHE.get(cache_key)
+        if chunk_prog is None:
+            from videop2p_tpu.obs.ledger import instrumented_jit
+
+            chunk_prog = instrumented_jit(
+                hybrid_chunk_fn, program="null_text_chunked"
+            )
+            _cache_put(_CHUNK_SCAN_CACHE, _CHUNK_SCAN_CACHE_MAX,
+                       cache_key, chunk_prog)
+        pieces = None
+        for start in range(0, num_inference_steps, outer_chunk):
+            chunk = jax.tree.map(
+                lambda a: a[start : start + outer_chunk], hybrid_xs
+            )
+            ys = chunk_prog(params, cond_embedding, chunk)
+            if pieces is None:
+                pieces = [[] for _ in ys]
+            for lst, y in zip(pieces, ys):
+                lst.append(y)
+        return pack(*(
+            jax.tree.map(lambda *xs_: jnp.concatenate(xs_, axis=0), *lst)
+            for lst in pieces
+        ))
+
     if not outer_chunk or outer_chunk >= num_inference_steps:
         _, ys = jax.lax.scan(
             make_body(params, cond_embedding), (x_t, uncond_embedding, key), xs
@@ -535,7 +713,7 @@ def null_text_optimization(
     cache_key = (
         unet_fn, id(scheduler), id(dependent_sampler), float(guidance_scale),
         int(num_inner_steps), int(num_inference_steps), float(dependent_weight),
-        bool(early_stop), null_text_precision, bool(telemetry),
+        bool(early_stop), null_text_precision, null_text_mode, bool(telemetry),
     )
     chunk_scan = _CHUNK_SCAN_CACHE.get(cache_key)
     if chunk_scan is None:
@@ -579,6 +757,8 @@ def null_text_optimization_fused(
     num_inner_steps: int = 10,
     epsilon: float = 1e-5,
     null_text_precision: str = "fp32",
+    null_text_mode: str = "optimize",
+    hybrid_inner_steps: int = 3,
     dependent_weight: float = 0.0,
     dependent_sampler: Optional[DependentNoiseSampler] = None,
     key: Optional[jax.Array] = None,
@@ -604,6 +784,10 @@ def null_text_optimization_fused(
     :func:`null_text_optimization` (which this wraps): bf16 UNet forwards in
     ``"mixed"`` with fp32 scheduler coefficients (core/ddim.py islands),
     fp32 Adam state, and fp32 loss/early-stop accumulation.
+    ``null_text_mode``/``hybrid_inner_steps`` select the amortized
+    (closed-form negative-prompt) or hybrid (joint K-step refinement)
+    substitutes, likewise passed through — every mode compiles to one
+    donated-trajectory device program here.
 
     Watchdog note: at SD scale the fp32 fixed-10 program can be a
     multi-minute single device call — the TPU runtime's execution watchdog
@@ -627,6 +811,10 @@ def null_text_optimization_fused(
             f"null_text_precision {null_text_precision!r} not in "
             f"{_NULL_TEXT_PRECISIONS}"
         )
+    if null_text_mode not in _NULL_TEXT_MODES:
+        raise ValueError(
+            f"null_text_mode {null_text_mode!r} not in {_NULL_TEXT_MODES}"
+        )
     if dependent_weight > 0.0 and dependent_sampler is None:
         raise ValueError("dependent_weight > 0 requires dependent_sampler")
     if telemetry and not return_stats:
@@ -644,8 +832,8 @@ def null_text_optimization_fused(
     cache_key = (
         unet_fn, id(scheduler), id(dependent_sampler), float(guidance_scale),
         int(num_inner_steps), int(num_inference_steps), float(dependent_weight),
-        float(epsilon), bool(early_stop), null_text_precision, bool(donate),
-        bool(telemetry),
+        float(epsilon), bool(early_stop), null_text_precision, null_text_mode,
+        int(hybrid_inner_steps), bool(donate), bool(telemetry),
     )
     program = _FUSED_PROGRAM_CACHE.get(cache_key)
     if program is None:
@@ -658,6 +846,8 @@ def null_text_optimization_fused(
                 num_inner_steps=num_inner_steps,
                 epsilon=epsilon,
                 null_text_precision=null_text_precision,
+                null_text_mode=null_text_mode,
+                hybrid_inner_steps=hybrid_inner_steps,
                 dependent_weight=dependent_weight,
                 dependent_sampler=dependent_sampler,
                 key=k,

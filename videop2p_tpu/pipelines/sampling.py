@@ -129,6 +129,7 @@ def edit_sample(
     blend_res: Optional[Tuple[int, int]] = None,
     null_uncond_embeddings: Optional[jax.Array] = None,
     cached_source: Optional[CachedSource] = None,
+    step_positions=None,
     telemetry: bool = False,
     device_probe: Optional[Callable] = None,
     attn_maps: bool = False,
@@ -152,6 +153,20 @@ def edit_sample(
     exactly and the controllers read its attention maps from the capture
     (see :mod:`videop2p_tpu.pipelines.cached`). Requires
     ``source_uses_cfg=False``, ``eta=0`` and no null-text embeddings.
+
+    ``step_positions``: the step-reduction seam (cached mode only). A
+    strictly increasing sequence of ``num_inference_steps`` positions into
+    the capture's base edit-step grid
+    (:meth:`~videop2p_tpu.core.ddim.DDIMScheduler.subset_positions` is the
+    canonical producer) — the edit then visits only those base timesteps
+    from ONE base-steps inversion: the source replay reads the trajectory
+    at the visited grid points (still exact — stream 0 stays the capture's
+    x_0 bit-for-bit), the captured maps are indexed at the mapped base
+    steps, and the scheduler walks the non-uniform grid via explicit
+    ``prev_timestep``. The controller must be built for the SUBSET step
+    count; gated subset steps must map inside the captured windows
+    (``pipelines.cached.check_subset_windows`` — validated here when the
+    controller is concrete, and by the serving layer before tracing).
 
     Per-frame ("multi") conditioning (pipeline_tuneavideo.py:366-367,399-402):
     pass ``cond_embeddings`` as (P, F, L, D); ``uncond_embeddings`` stays
@@ -217,6 +232,11 @@ def edit_sample(
             uncond_embeddings[None], (video_length,) + uncond_embeddings.shape
         )
 
+    if step_positions is not None and cached_source is None:
+        raise ValueError(
+            "step_positions is the cached fast path's step-reduction seam — "
+            "it requires cached_source"
+        )
     if cached_source is not None:
         if source_uses_cfg:
             raise ValueError("cached_source requires fast mode (source_uses_cfg=False)")
@@ -231,17 +251,30 @@ def edit_sample(
                 "live source stream stochastic while the cached replay is "
                 "deterministic"
             )
-        if cached_source.num_steps != num_inference_steps:
+        if step_positions is not None:
+            from videop2p_tpu.pipelines.cached import validate_step_positions
+
+            step_positions = validate_step_positions(
+                step_positions, cached_source.num_steps
+            )
+            if len(step_positions) != num_inference_steps:
+                raise ValueError(
+                    f"step_positions has {len(step_positions)} entries, edit "
+                    f"runs {num_inference_steps}"
+                )
+        elif cached_source.num_steps != num_inference_steps:
             raise ValueError(
                 f"cached trajectory covers {cached_source.num_steps} steps, "
-                f"edit runs {num_inference_steps}"
+                f"edit runs {num_inference_steps} (pass step_positions for a "
+                "timestep-subset fast path from one inversion)"
             )
         return _edit_sample_cached(
             unet_fn, params, scheduler, latents, cond_embeddings,
             uncond_embeddings, cached_source,
             num_inference_steps=num_inference_steps,
             guidance_scale=guidance_scale, ctx=ctx,
-            blend_res=blend_res, telemetry=telemetry,
+            blend_res=blend_res, step_positions=step_positions,
+            telemetry=telemetry,
             device_probe=device_probe, attn_maps=attn_maps,
         )
 
@@ -414,6 +447,7 @@ def _edit_sample_cached(
     guidance_scale: float,
     ctx: Optional[ControlContext],
     blend_res: Optional[Tuple[int, int]],
+    step_positions=None,
     telemetry: bool = False,
     device_probe: Optional[Callable] = None,
     attn_maps: bool = False,
@@ -425,8 +459,12 @@ def _edit_sample_cached(
     ``eta=0`` requirement means no randomness enters the loop.
 
     Inputs arrive normalized by :func:`edit_sample` (latents broadcast to
-    (P, F, h, w, C), uncond as (L, D) — or per-frame in multi mode).
+    (P, F, h, w, C), uncond as (L, D) — or per-frame in multi mode);
+    ``step_positions`` (already validated) selects a timestep subset of the
+    capture's base grid — the few-step fast path from one inversion.
     """
+    import numpy as np
+
     P = cond_embeddings.shape[0]
     E = P - 1  # edit streams
     U = E  # their uncond streams
@@ -435,7 +473,29 @@ def _edit_sample_cached(
     video_length = latents.shape[1]
     latent_hw = latents.shape[2:4]
     text_len = cond_embeddings.shape[-2]
-    timesteps = jnp.asarray(scheduler.timesteps(num_inference_steps))
+    subset = step_positions is not None
+    if subset:
+        base_steps = cached.num_steps
+        positions = np.asarray(step_positions, dtype=np.int64)
+        base_ts = np.asarray(scheduler.timesteps(base_steps))
+        ts_np = base_ts[positions]
+        ratio = scheduler.num_train_timesteps // base_steps
+        # step j lands on the next subset timestep; the last step lands on
+        # the base walk's own terminal target (< 0 → final ᾱ), so every
+        # subset walk ends at the same "clean" state as the base walk
+        prev_ts_np = np.concatenate([ts_np[1:], [base_ts[-1] - ratio]])
+        timesteps = jnp.asarray(ts_np)
+        # gate-coverage validation needs a CONCRETE controller; under a
+        # trace (the serving programs pass ctx as a jit argument) the
+        # caller validates before tracing (serve/programs.py does)
+        if ctx is not None and not isinstance(
+            ctx.cross_replace_alpha, jax.core.Tracer
+        ):
+            from videop2p_tpu.pipelines.cached import check_subset_windows
+
+            check_subset_windows(ctx, cached, positions, num_inference_steps)
+    else:
+        timesteps = jnp.asarray(scheduler.timesteps(num_inference_steps))
 
     edit_latents = latents[1:]  # (E, F, h, w, C), fp32 from the caller
     cond_edit = cond_embeddings[1:]
@@ -465,8 +525,15 @@ def _edit_sample_cached(
             "LocalBlend is configured but the capture has no blend_seq — run "
             "ddim_inversion_captured(capture_blend=True)"
         )
-    # src_seq[i] = source latent AFTER edit step i (= trajectory[N−i−1])
-    src_seq = cached.src_latents[1:]
+    # src_seq[i] = source latent AFTER edit step i (= trajectory[N−i−1]);
+    # a subset walk's step j lands on the NEXT visited grid point, and its
+    # last step lands on x_0 — the replay reads exact trajectory values
+    # either way
+    if subset:
+        positions_next = np.append(positions[1:], base_steps)
+        src_seq = cached.src_latents[jnp.asarray(positions_next)]
+    else:
+        src_seq = cached.src_latents[1:]
 
     maps_sum = None
     if use_blend:
@@ -501,12 +568,18 @@ def _edit_sample_cached(
 
     def body(carry, xs):
         edit_latents, maps_sum = carry
-        t, i, src_after, blend_src = xs
+        if subset:
+            # base_i indexes the captured maps at the mapped base step; the
+            # controller's own gates stay in subset-step space (i)
+            t, i, src_after, blend_src, base_i, prev_t = xs
+        else:
+            t, i, src_after, blend_src = xs
+            base_i, prev_t = i, None
         latent_in = jnp.concatenate([edit_latents, edit_latents], axis=0)
         control = (
             AttnControl(
                 ctx=ctx, step_index=i, num_uncond=U,
-                cached_base=cached.base_tree_at(i),
+                cached_base=cached.base_tree_at(base_i),
                 cached_source=True,
             )
             if ctx is not None
@@ -516,7 +589,8 @@ def _edit_sample_cached(
         eps_uncond, eps_text = eps_all[:E], eps_all[E:]
         eps = eps_uncond + guidance_scale * (eps_text - eps_uncond)
         edit_latents, _ = scheduler.step(
-            eps, t, edit_latents, num_inference_steps, eta=0.0, variance_noise=None
+            eps, t, edit_latents, num_inference_steps, eta=0.0,
+            variance_noise=None, prev_timestep=prev_t,
         )
 
         if use_blend:
@@ -560,12 +634,17 @@ def _edit_sample_cached(
         ys = _pack_step_outputs(telemetry, tel, attn_maps, attn, dev)
         return (edit_latents, maps_sum), ys
 
-    blend_xs = (
-        cached.blend_seq
-        if cached.blend_seq is not None
-        else jnp.zeros((num_inference_steps, 0))
-    )
+    if cached.blend_seq is None:
+        blend_xs = jnp.zeros((num_inference_steps, 0))
+    elif subset:
+        # the source's blend contribution captured AT each visited step;
+        # the mask's running sum covers fewer steps but is max-normalized
+        blend_xs = cached.blend_seq[jnp.asarray(positions)]
+    else:
+        blend_xs = cached.blend_seq
     xs = (timesteps, jnp.arange(num_inference_steps), src_seq, blend_xs)
+    if subset:
+        xs += (jnp.asarray(positions, jnp.int32), jnp.asarray(prev_ts_np))
     (edit_latents, _), ys = jax.lax.scan(body, (edit_latents, maps_sum), xs)
     # stream 0 = the exact inversion reconstruction (trajectory[0] = x_0)
     out = jnp.concatenate([cached.src_latents[-1], edit_latents], axis=0)
@@ -593,6 +672,8 @@ def official_edit(
     num_inner_steps: int = 10,
     epsilon: float = 1e-5,
     null_text_precision: str = "fp32",
+    null_text_mode: str = "optimize",
+    hybrid_inner_steps: int = 3,
     early_stop: bool = True,
     dependent_weight: float = 0.0,
     dependent_sampler: Optional[DependentNoiseSampler] = None,
@@ -624,6 +705,11 @@ def official_edit(
     Returns final latents (P, F, h, w, C); with ``return_null_stats=True``
     returns ``(latents, stats)`` — the fused null-text program's
     ``{"final_loss", "inner_steps"}`` record.
+
+    ``null_text_mode``/``hybrid_inner_steps`` select the amortized
+    (closed-form negative-prompt) or hybrid (joint K-step) null-text
+    substitutes (pipelines/inversion.py) inside the same single program —
+    the ≥3× cheaper official path the quality rules gate.
     """
     # lazy import: inversion.py imports this module for the UNetFn contract
     from videop2p_tpu.pipelines.inversion import null_text_optimization
@@ -644,7 +730,8 @@ def official_edit(
         unet_fn, id(scheduler), id(dependent_sampler), id(ctx),
         float(guidance_scale), int(num_inner_steps), int(num_inference_steps),
         float(dependent_weight), float(epsilon), float(eta),
-        bool(early_stop), null_text_precision, blend_res, bool(donate),
+        bool(early_stop), null_text_precision, null_text_mode,
+        int(hybrid_inner_steps), blend_res, bool(donate),
     )
     program = _OFFICIAL_EDIT_CACHE.get(cache_key)
     if program is None:
@@ -658,6 +745,8 @@ def official_edit(
                 num_inner_steps=num_inner_steps,
                 epsilon=epsilon,
                 null_text_precision=null_text_precision,
+                null_text_mode=null_text_mode,
+                hybrid_inner_steps=hybrid_inner_steps,
                 dependent_weight=dependent_weight,
                 dependent_sampler=dependent_sampler,
                 key=k_null,

@@ -50,7 +50,7 @@ __all__ = ["EditRequest", "EditEngine"]
 _REQUEST_FIELDS = (
     "image_path", "prompt", "prompts", "save_name", "is_word_swap",
     "blend_word", "eq_params", "cross_replace_steps", "self_replace_steps",
-    "seed",
+    "seed", "steps",
 )
 
 
@@ -72,6 +72,12 @@ class EditRequest:
     cross_replace_steps: float = 0.2
     self_replace_steps: float = 0.5
     seed: int = 0
+    # per-request DDIM step count (the latency-vs-quality knob): None = the
+    # spec's base count; fewer steps run the timestep-subset fast path from
+    # the SAME base-steps inversion products. Must be a warmed bucket —
+    # the engine rejects unknown step geometry at admission (HTTP 400)
+    # rather than compiling cold mid-serve.
+    steps: Optional[int] = None
     frames: Optional[np.ndarray] = None
 
     @classmethod
@@ -95,16 +101,20 @@ class EditRequest:
             raise ValueError("prompts[0] must equal the source prompt")
         if self.frames is None and not self.image_path:
             raise ValueError("request needs 'image_path' (or in-process frames)")
+        if self.steps is not None and (not isinstance(self.steps, int)
+                                       or self.steps < 1):
+            raise ValueError(f"'steps' must be a positive int, got {self.steps!r}")
 
 
 @dataclass
 class _Prepared:
     """A resolved request, ready to batch: the device argument tree plus
-    its batching-compatibility key."""
+    its batching-compatibility key and resolved step count."""
 
     rid: str
     args: Tuple  # (cached, cond_all, uncond, ctx, anchor)
     compat: str
+    steps: int
 
 
 class EditEngine:
@@ -140,6 +150,9 @@ class EditEngine:
         )
         self.programs = programs if programs is not None else ProgramSet(spec)
         self.spec = self.programs.spec
+        # per-request `steps` is admitted only against this set — unknown
+        # step geometry is a 400 at submit, never a cold compile mid-serve
+        self.warm_steps = {self.spec.steps}
         self.store = InversionStore(store_budget_bytes, persist_dir=persist_dir)
         self._spec_fp = self.spec.fingerprint()
         self._requests: Dict[str, Dict[str, Any]] = {}
@@ -158,22 +171,38 @@ class EditEngine:
 
     def warm(self, prompts: Sequence[str] = ("a video", "an edited video"),
              *, controller_kwargs: Optional[Dict] = None,
-             batch_sizes: Sequence[int] = (2,)) -> Dict[str, Any]:
+             batch_sizes: Sequence[int] = (2,),
+             step_buckets: Sequence[int] = ()) -> Dict[str, Any]:
         """Compile the request path on zeros (see
         :meth:`videop2p_tpu.serve.programs.ProgramSet.warm`); the summary
-        lands in the ledger and ``/healthz``."""
+        lands in the ledger and ``/healthz``. ``step_buckets`` additionally
+        warms few-step timestep-subset edit variants — the step counts
+        per-request ``steps`` may then ask for."""
         info = self.programs.warm(
             prompts, controller_kwargs=controller_kwargs,
             batch_sizes=batch_sizes, dispatch=self.batch_dispatch,
+            step_buckets=step_buckets,
         )
+        self.warm_steps.update(info.get("steps", []))
         self.ledger.event("serve_warm", **info)
         return info
 
     def submit(self, request: EditRequest) -> str:
-        """Enqueue one request; returns its id immediately."""
+        """Enqueue one request; returns its id immediately. A per-request
+        ``steps`` outside the warmed buckets raises ``ValueError`` (the
+        HTTP layer's 400) listing the warm list — unknown step geometry
+        must not silently compile cold mid-serve."""
         if self._closed:
             raise RuntimeError("engine is closed")
         request.validate()
+        steps = int(request.steps) if request.steps else self.spec.steps
+        if steps not in self.warm_steps:
+            raise ValueError(
+                f"steps={steps} is not a warmed step bucket (warmed: "
+                f"{sorted(self.warm_steps)}) — cold step geometry would "
+                "compile mid-serve; warm it first "
+                "(EditEngine.warm(step_buckets=...) / cli.serve --step_buckets)"
+            )
         rid = uuid.uuid4().hex[:12]
         rec = {
             "id": rid,
@@ -339,14 +368,18 @@ class EditEngine:
         self._update(rid, status="resolving")
         try:
             ps = self.programs
-            ctx = ps.controller(
-                list(request.prompts),
+            steps = int(request.steps) if request.steps else self.spec.steps
+            controller_kwargs = dict(
                 is_word_swap=request.is_word_swap,
                 cross_replace_steps=request.cross_replace_steps,
                 self_replace_steps=request.self_replace_steps,
                 blend_word=request.blend_word,
                 eq_params=request.eq_params,
             )
+            # the BASE-steps controller keys the store/capture (inversions
+            # are always captured at the base grid); a few-step request
+            # additionally builds its own subset-space controller below
+            ctx = ps.controller(list(request.prompts), **controller_kwargs)
             cond_all = ps.encode_prompts(list(request.prompts))
             uncond = ps.encode_prompts([""])[0]
             key = self._store_key(request, ctx)
@@ -381,15 +414,24 @@ class EditEngine:
                           "video_len": self.spec.video_len},
                 )
             cached, anchor = products
-            args = (cached, cond_all, uncond, ctx, anchor)
+            ctx_edit = ctx
+            if steps != self.spec.steps:
+                from videop2p_tpu.pipelines.cached import check_subset_windows
+
+                ctx_edit = ps.controller(
+                    list(request.prompts), steps=steps, **controller_kwargs
+                )
+                _, positions = ps.step_plan(steps)
+                check_subset_windows(ctx_edit, cached, positions, steps)
+            args = (cached, cond_all, uncond, ctx_edit, anchor)
             dt = time.perf_counter() - t0
             self.ledger.record_execute("serve_resolve", dt, dt)
-            self._update(rid, store_hit=hit, store_key=key,
+            self._update(rid, store_hit=hit, store_key=key, steps=steps,
                          resolve_s=round(dt, 4))
             return _Prepared(
-                rid=rid, args=args,
+                rid=rid, args=args, steps=steps,
                 compat=compat_key(args, extra=(
-                    self._spec_fp, self.spec.steps, self.spec.guidance_scale,
+                    self._spec_fp, steps, self.spec.guidance_scale,
                     self.batch_dispatch,
                 )),
             )
@@ -406,15 +448,19 @@ class EditEngine:
                          padded_size=plan.padded_size)
         try:
             ps = self.programs
+            # compat keys carry the step count, so a plan is steps-homogeneous
+            steps = plan.items[0].steps
             if plan.padded_size == 1:
-                videos, src_err = ps.edit_decode(*plan.items[0].args)
+                videos, src_err = ps.edit_decode(*plan.items[0].args,
+                                                 steps=steps)
                 outs = [(videos, src_err)]
             else:
                 stacked = stack_items(
                     [p.args for p in plan.items], plan.padded_size
                 )
                 videos_b, src_err_b = ps.edit_decode_batch(
-                    stacked, plan.padded_size, dispatch=self.batch_dispatch
+                    stacked, plan.padded_size, dispatch=self.batch_dispatch,
+                    steps=steps,
                 )
                 outs = unstack_outputs(
                     (videos_b, src_err_b), len(plan.items)

@@ -4,6 +4,9 @@ Endpoints (all JSON):
 
   * ``POST /v1/edits``           — submit an :class:`EditRequest` body →
     ``{"id": ...}`` (202). Clips are server-local paths (``image_path``).
+    An optional ``"steps"`` field selects a few-step timestep-subset edit;
+    step counts outside the engine's warmed buckets return 400 with the
+    warm list (unknown geometry never compiles cold mid-serve).
   * ``GET  /v1/edits/<id>``      — poll one request's record.
   * ``GET  /v1/edits/<id>/result?wait_s=N`` — block up to N s for a
     terminal record.

@@ -185,8 +185,11 @@ class ProgramSet:
         blend_word: Optional[Sequence[str]] = None,
         eq_params: Optional[Dict] = None,
         mask_th: Tuple[float, float] = MASK_TH,
+        steps: Optional[int] = None,
     ):
-        """The CLI's controller construction, spec-bound (num_steps)."""
+        """The CLI's controller construction, spec-bound (num_steps);
+        ``steps`` overrides for a timestep-subset (few-step) edit, whose
+        gates live in subset-step space."""
         from videop2p_tpu.control import make_controller
 
         blend_words = None
@@ -195,7 +198,7 @@ class ProgramSet:
         return make_controller(
             list(prompts),
             self.bundle.tokenizer,
-            num_steps=self.spec.steps,
+            num_steps=int(steps) if steps else self.spec.steps,
             is_replace_controller=bool(is_word_swap),
             cross_replace_steps=cross_replace_steps,
             self_replace_steps=self_replace_steps,
@@ -331,14 +334,37 @@ class ProgramSet:
         prog = self._program(statics, build)
         return prog(self.bundle.unet_params, latents, cond_src, key)
 
-    def _edit_fn(self):
+    def step_plan(self, steps: Optional[int] = None):
+        """Resolve a per-request step count against the spec's base steps:
+        ``(steps, positions)`` where ``positions`` is None at the base count
+        and the exact timestep-subset positions otherwise (the cached fast
+        path then runs few-step from the SAME base-steps inversion)."""
+        steps = int(steps) if steps else self.spec.steps
+        if steps == self.spec.steps:
+            return steps, None
+        if not 1 <= steps <= self.spec.steps:
+            raise ValueError(
+                f"steps={steps} outside [1, {self.spec.steps}] (the spec's "
+                "base step count — inversions are captured at the base grid)"
+            )
+        return steps, tuple(
+            int(p) for p in self.scheduler.subset_positions(
+                self.spec.steps, steps
+            )
+        )
+
+    def _edit_fn(self, steps: Optional[int] = None,
+                 positions: Optional[Tuple[int, ...]] = None):
         """The per-request edit+decode subcomputation — shared verbatim by
         the singleton program and every batched variant, which is what
-        makes scan-mode batching bit-exact vs singleton dispatch."""
+        makes scan-mode batching bit-exact vs singleton dispatch.
+        ``steps``/``positions``: the timestep-subset fast path (few-step
+        serving from the base-steps inversion products)."""
         from videop2p_tpu.models import decode_video
         from videop2p_tpu.pipelines import edit_sample
 
-        steps, guidance = self.spec.steps, self.spec.guidance_scale
+        guidance = self.spec.guidance_scale
+        steps = int(steps) if steps else self.spec.steps
 
         def fn(params, vp, cached, cond_all, uncond, ctx, anchor):
             out = edit_sample(
@@ -346,6 +372,7 @@ class ProgramSet:
                 cached.src_latents[0], cond_all, uncond,
                 num_inference_steps=steps, guidance_scale=guidance,
                 ctx=ctx, source_uses_cfg=False, cached_source=cached,
+                step_positions=positions,
             )
             vids = decode_video(
                 self.bundle.vae, vp, out.astype(self.dtype), sequential=True
@@ -359,21 +386,35 @@ class ProgramSet:
 
         return fn
 
-    def edit_decode(self, cached, cond_all, uncond, ctx, anchor):
+    def edit_decode(self, cached, cond_all, uncond, ctx, anchor, *,
+                    steps: Optional[int] = None):
         """One request: cached-source controlled edit + VAE decode as one
-        dispatch. Returns ``(videos01 (P,F,H,W,3), src_err scalar)``."""
+        dispatch. Returns ``(videos01 (P,F,H,W,3), src_err scalar)``.
+        ``steps`` < the spec's base count runs the timestep-subset fast
+        path from the same inversion products (the controller must be
+        built for that step count — :meth:`controller`'s ``steps=``)."""
         from videop2p_tpu.obs import instrumented_jit
 
-        inner = self._edit_fn()
+        steps, positions = self.step_plan(steps)
+        if positions is not None and ctx is not None:
+            # gate-coverage check BEFORE tracing: ctx enters the program as
+            # a traced argument, where the in-pipeline check cannot run
+            from videop2p_tpu.pipelines.cached import check_subset_windows
+
+            check_subset_windows(ctx, cached, positions, steps)
+        label = ("serve_edit" if steps == self.spec.steps
+                 else f"serve_edit_s{steps}")
+        inner = self._edit_fn(steps, positions)
         prog = self._program(
-            ("serve_edit", self.spec.steps, self.spec.guidance_scale),
-            lambda: instrumented_jit(inner, program="serve_edit"),
+            ("serve_edit", steps, self.spec.guidance_scale),
+            lambda: instrumented_jit(inner, program=label),
         )
         return prog(self.bundle.unet_params, self.bundle.vae_params,
                     cached, cond_all, uncond, ctx, anchor)
 
     def edit_decode_batch(self, stacked_args, size: int, *,
-                          dispatch: str = "scan"):
+                          dispatch: str = "scan",
+                          steps: Optional[int] = None):
         """``size`` compatible requests stacked on a leading batch axis →
         one dispatch. ``stacked_args`` is the stacked
         ``(cached, cond_all, uncond, ctx, anchor)`` tree
@@ -382,12 +423,17 @@ class ProgramSet:
         ``dispatch="scan"``: ``lax.map`` — per-item math identical to the
         singleton program (bit-exact, pinned by tests); ``"vmap"``:
         vectorized, and on a ``data``-mesh the batch axis is sharded
-        across chips (true data-parallel serving, allclose-gated)."""
+        across chips (true data-parallel serving, allclose-gated).
+        ``steps``: the per-request step count (the batch planner only
+        groups same-steps requests — compat keys carry it); subset-window
+        validation happens per request at resolve time, before stacking."""
         from videop2p_tpu.obs import instrumented_jit
 
         if dispatch not in ("scan", "vmap"):
             raise ValueError(f"dispatch must be 'scan' or 'vmap', got {dispatch!r}")
-        inner = self._edit_fn()
+        steps, positions = self.step_plan(steps)
+        inner = self._edit_fn(steps, positions)
+        suffix = "" if steps == self.spec.steps else f"_s{steps}"
 
         def build():
             def fn(params, vp, stacked):
@@ -396,11 +442,13 @@ class ProgramSet:
                     return jax.lax.map(one, stacked)
                 return jax.vmap(one)(stacked)
 
-            return instrumented_jit(fn, program=f"serve_edit_b{size}_{dispatch}")
+            return instrumented_jit(
+                fn, program=f"serve_edit_b{size}_{dispatch}{suffix}"
+            )
 
         prog = self._program(
             ("serve_edit_batch", size, dispatch,
-             self.spec.steps, self.spec.guidance_scale),
+             steps, self.spec.guidance_scale),
             build,
         )
         stacked_args = self._shard_batch(stacked_args, size)
@@ -430,13 +478,17 @@ class ProgramSet:
         controller_kwargs: Optional[Dict] = None,
         batch_sizes: Sequence[int] = (),
         dispatch: str = "scan",
+        step_buckets: Sequence[int] = (),
     ) -> Dict[str, Any]:
         """Compile (and execute once, on zeros) the request-path programs:
-        encode → invert-capture → edit+decode, plus any batched variants.
+        encode → invert-capture → edit+decode, plus any batched variants
+        and any few-step (``step_buckets``) variants — every bucket runs
+        from the SAME base-steps inversion via exact timestep subsets.
         The warm structure should match expected traffic (same prompt
         count / controller structure); mismatched requests still work,
         they just pay their own first compile. Returns a summary the
-        ``/healthz`` endpoint reports."""
+        ``/healthz`` endpoint reports (``steps`` is the warmed-bucket list
+        the engine admits per-request ``steps`` against)."""
         t0 = time.perf_counter()
         spec = self.spec
         ctx = self.controller(prompts, **dict(controller_kwargs or {}))
@@ -462,10 +514,23 @@ class ProgramSet:
             jax.block_until_ready(
                 self.edit_decode_batch(stacked, size, dispatch=dispatch)[0]
             )
+        warmed_steps = {spec.steps}
+        for s in step_buckets:
+            s = int(s)
+            if s == spec.steps:
+                continue
+            ctx_s = self.controller(
+                prompts, steps=s, **dict(controller_kwargs or {})
+            )
+            jax.block_until_ready(self.edit_decode(
+                cached, cond_all, uncond, ctx_s, anchor, steps=s
+            )[0])
+            warmed_steps.add(s)
         self.warmed = {
             "seconds": round(time.perf_counter() - t0, 3),
             "prompts": list(prompts),
             "batch_sizes": sorted({1, *[int(s) for s in batch_sizes]}),
+            "steps": sorted(warmed_steps),
             "src_err": float(np.asarray(jax.device_get(src_err))),
         }
         return self.warmed
