@@ -454,8 +454,11 @@ def test_report_and_obs_import_only_stdlib_numpy_jax():
     # the engine reaches models only through the package
     serve_dir = os.path.join(_REPO, "videop2p_tpu", "serve")
     serve_files = sorted(f for f in os.listdir(serve_dir) if f.endswith(".py"))
+    # ISSUE 9 pin: the resilience layer (fault injection, breaker, retry)
+    # joins the guarded set — chaos machinery must run anywhere the engine
+    # does, so it stays stdlib
     assert {"engine.py", "store.py", "batching.py", "programs.py",
-            "http.py", "client.py"} <= set(serve_files)
+            "http.py", "client.py", "faults.py"} <= set(serve_files)
     files += [os.path.join(serve_dir, f) for f in serve_files]
     offenders = []
     for path in files:
@@ -617,6 +620,50 @@ def test_execute_timing_and_trace_ledger_event_schema(tmp_path):
     events = read_ledger(path)
     assert [e["count"] for e in events
             if e["event"] == "execute_timing"] == [1, 1]
+
+
+def test_fault_and_serve_health_ledger_event_schema(tmp_path):
+    """Schema pin (ISSUE 9): the ``fault`` / ``breaker`` / ``serve_health``
+    ledger events carry their documented field sets, FAULT_RULES ride in
+    DEFAULT_RULES, and obs/history.py's reliability section extracts them
+    — tools/obs_diff.py's reliability table and exit-1 teeth key on these
+    names."""
+    from videop2p_tpu.obs import RunLedger, read_ledger
+    from videop2p_tpu.obs.history import (
+        DEFAULT_RULES,
+        FAULT_RULES,
+        extract_run,
+        split_runs,
+    )
+    from videop2p_tpu.serve.faults import (
+        BREAKER_EVENT_FIELDS,
+        FAULT_EVENT_FIELDS,
+        SERVE_HEALTH_FIELDS,
+    )
+
+    assert all(r in DEFAULT_RULES for r in FAULT_RULES)
+    assert {r.metric for r in FAULT_RULES} == {
+        "error_rate", "shed_rate", "breaker_trips", "deadline_exceeded"}
+    assert all(r.kind == "reliability" for r in FAULT_RULES)
+
+    health = {k: 0 for k in SERVE_HEALTH_FIELDS}
+    health.update(requests=3, done=2, errors=1, error_rate=round(1 / 3, 4))
+    path = str(tmp_path / "ledger.jsonl")
+    with RunLedger(path) as led:
+        led.fault("backend_unavailable", detail="attempt=4")
+        led.breaker("closed", "open", consecutive_failures=2, trips=1)
+        led.event("serve_health", **health)
+    by_kind = {e["event"]: e for e in read_ledger(path)}
+    assert set(FAULT_EVENT_FIELDS) <= set(by_kind["fault"])
+    assert by_kind["fault"]["kind"] == "backend_unavailable"
+    assert set(BREAKER_EVENT_FIELDS) <= set(by_kind["breaker"])
+    assert set(SERVE_HEALTH_FIELDS) <= set(by_kind["serve_health"])
+    rec = extract_run(split_runs(read_ledger(path))[-1])
+    rel = rec["reliability"]["serve"]
+    assert set(SERVE_HEALTH_FIELDS) <= set(rel)
+    assert rel["error_rate"] == round(1 / 3, 4)
+    # pre-PR-9 ledgers extract an empty (but present) reliability section
+    assert extract_run([{"event": "run_start"}])["reliability"] == {}
 
 
 def test_no_wall_clock_in_timed_regions():

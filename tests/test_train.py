@@ -1,6 +1,8 @@
 """Stage-1 tuning tests: trainable-mask rule, loss descent, freeze guarantee,
 lr schedules, checkpoint round-trip."""
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -212,6 +214,101 @@ def test_checkpoint_roundtrip(tmp_path, tiny):
     for a, b in zip(jax.tree_util.tree_leaves(state), jax.tree_util.tree_leaves(restored)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     assert latest_checkpoint(str(tmp_path / "nope")) is None
+    # ISSUE 9 pin: restored leaves are jax-OWNED buffers (copied, not
+    # zero-copy views of orbax/tensorstore storage), so the resume path's
+    # donated train_steps carry cannot alias memory jax does not own — the
+    # use-after-free showed up as garbage weights in the resumed run's
+    # next checkpoint before restore_checkpoint copied
+    assert all(isinstance(leaf, jax.Array)
+               for leaf in jax.tree_util.tree_leaves(restored)
+               if hasattr(leaf, "shape"))
+    donated = jax.jit(lambda t: jax.tree.map(lambda x: x + 0, t),
+                      donate_argnums=0)(restored)
+    for a, b in zip(jax.tree_util.tree_leaves(state),
+                    jax.tree_util.tree_leaves(donated)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_preempt_signal_handler_sets_event_and_restores():
+    """ISSUE 9 satellite: run_tuning installs SIGTERM/SIGINT handlers that
+    set the preemption event (checked at every chunk boundary) and
+    restores the previous handlers afterwards."""
+    import signal
+
+    from videop2p_tpu.cli import run_tuning as rt
+
+    assert not rt._PREEMPT_EVENT.is_set()
+    before = signal.getsignal(signal.SIGTERM)
+    restore = rt._install_preempt_handlers()
+    try:
+        assert signal.getsignal(signal.SIGTERM) is rt._preempt_handler
+        assert signal.getsignal(signal.SIGINT) is rt._preempt_handler
+        signal.raise_signal(signal.SIGTERM)  # delivered synchronously
+        assert rt._PREEMPT_EVENT.is_set()
+    finally:
+        rt._PREEMPT_EVENT.clear()
+        restore()
+    assert signal.getsignal(signal.SIGTERM) is before
+
+
+def _tune_cfg(root, name, **over):
+    cfg = dict(
+        pretrained_model_path=str(root / f"no_ckpt_{name}"),
+        output_dir=str(root / name),
+        train_data={"video_path": "data/rabbit", "prompt": "a rabbit is jumping",
+                    "n_sample_frames": 2, "width": 16, "height": 16},
+        # no validation work: empty prompt list, no inversion
+        validation_data={"prompts": [], "use_inv_latent": False},
+        max_train_steps=4, steps_per_call=2, log_every=2,
+        checkpointing_steps=0, validation_steps=0,
+        tiny=True, mixed_precision="no", seed=0,
+        gradient_checkpointing=False,
+    )
+    cfg.update(over)
+    return cfg
+
+
+@pytest.mark.slow  # ~30 s: three tiny end-to-end tuning runs
+def test_tuning_preemption_checkpoint_and_bit_identical_resume(
+    tmp_path, monkeypatch
+):
+    """ISSUE 9 satellite — preemption safety e2e: a preempted run saves a
+    final checkpoint at the chunk boundary and exits WITHOUT exporting a
+    pipeline; auto-resume from `latest` continues to completion and the
+    tuned weights are BIT-IDENTICAL to an uninterrupted run (per-step
+    noise keys derive from (run key, absolute step), so the resume
+    boundary cannot change the noise sequence)."""
+    import threading
+
+    from videop2p_tpu.cli import run_tuning as rt
+
+    # deterministic "SIGTERM already pending": the loop preempts at the
+    # FIRST chunk boundary (step 2 of 4)
+    monkeypatch.setattr(rt, "_PREEMPT_EVENT", threading.Event())
+    rt._PREEMPT_EVENT.set()
+    out_b = rt.main(**_tune_cfg(tmp_path, "interrupted"))
+    ckpt = latest_checkpoint(out_b)
+    assert ckpt is not None and ckpt.endswith("checkpoint-2")
+    assert not os.path.isfile(os.path.join(out_b, "model_index.json"))
+
+    # auto-resume continues 2 -> 4 and exports the pipeline
+    monkeypatch.setattr(rt, "_PREEMPT_EVENT", threading.Event())
+    out_b2 = rt.main(**_tune_cfg(tmp_path, "interrupted",
+                                 resume_from_checkpoint="latest"))
+    assert out_b2 == out_b
+    weights_b = os.path.join(out_b, "unet",
+                             "diffusion_pytorch_model.safetensors")
+    assert os.path.isfile(weights_b)
+
+    # the uninterrupted reference run
+    out_a = rt.main(**_tune_cfg(tmp_path, "straight"))
+    weights_a = os.path.join(out_a, "unet",
+                             "diffusion_pytorch_model.safetensors")
+    with open(weights_a, "rb") as fa, open(weights_b, "rb") as fb:
+        assert fa.read() == fb.read(), (
+            "resumed weights differ from the uninterrupted run — the "
+            "resume boundary changed the training trajectory"
+        )
 
 
 @pytest.mark.slow  # ~19 s: two full UNet grad compiles (policy vs none)

@@ -12,9 +12,12 @@ wall-clock, collective-communication accounting (``comm_analysis`` events
 programs), per-device peak-HBM residency (``memory`` snapshots), cross-replica
 divergence (must be 0.0 — the zero-noise-floor invariant), per-program
 execute-latency distributions (``execute_timing`` events — obs/timing.py:
-blocked p50/p99 regress by growing), and mined device traces
+blocked p50/p99 regress by growing), mined device traces
 (``trace_analysis`` events — obs/trace.py: device-total seconds regress
-by growing, the compute/collective overlap fraction by DROPPING)
+by growing, the compute/collective overlap fraction by DROPPING), and
+serving reliability (``serve_health`` events — serve/faults.py + the
+engine: error rate, load-shed rate, breaker trips and deadline expiries
+regress by appearing/growing, gated by ``FAULT_RULES``)
 between a baseline run and a new run, renders per-program tables,
 evaluates the declarative regression rules (obs/history.py DEFAULT_RULES;
 scale every threshold with ``--threshold-scale``), and:
@@ -233,6 +236,36 @@ def render_diff(base: Dict, new: Dict, result: Dict) -> str:
                 "by dropping):",
                 _table(rows, ["window", "device_total_s", "collective_s",
                               "overlap", "idle_s"])]
+
+    # reliability section (serve_health events — serve/faults.py, ISSUE 9):
+    # absent/empty for pre-PR-9 ledgers and non-serving runs, table omitted
+    rel = sorted(set(base.get("reliability") or {})
+                 | set(new.get("reliability") or {}))
+    if rel:
+        rows = []
+        for label in rel:
+            b = (base.get("reliability") or {}).get(label, {})
+            n = (new.get("reliability") or {}).get(label, {})
+
+            def fcell(metric, b=b, n=n):
+                bv, nv = b.get(metric), n.get(metric)
+                if bv is None and nv is None:
+                    return "-"
+                if bv is None or nv is None:
+                    return f"{_fmt(bv)} → {_fmt(nv)}"
+                if bv == nv:
+                    return _fmt(nv)
+                return f"{_fmt(bv)} → {_fmt(nv)}"
+
+            rows.append([label, fcell("requests"), fcell("error_rate"),
+                         fcell("shed"), fcell("shed_rate"),
+                         fcell("breaker_trips"), fcell("deadline_exceeded"),
+                         fcell("retries")])
+        out += ["", "reliability (serve_health — error/shed rates, breaker "
+                "trips):",
+                _table(rows, ["label", "requests", "error_rate", "sheds",
+                              "shed_rate", "breaker_trips",
+                              "deadline_exceeded", "retries"])]
 
     comp = sorted(set(base.get("compiles", {})) | set(new.get("compiles", {})))
     if comp:

@@ -16,6 +16,19 @@ and GATE with ``tools/obs_diff.py`` (``TIMING_RULES``) like any bench run:
 Closed loop = each worker submits its next request only after the previous
 one finished — the concurrency IS the offered load, so latency percentiles
 are comparable across runs without open-loop arrival modeling.
+
+Chaos mode (ISSUE 9): ``--faults <plan>`` (``--inproc`` only) drives the
+engine under a deterministic injected fault plan (serve/faults.py DSL —
+``fail@K``, ``hang@K:S``, ``unavail@A-B``, ``corrupt:PAT``), classifies
+outcomes per terminal status (done / error / deadline_exceeded / shed),
+copies the engine's ``fault``/``breaker`` events and its ``serve_health``
+summary into the loadgen ledger (so ``tools/obs_diff.py`` gates the run's
+reliability through ``FAULT_RULES`` exactly like its latency through
+``TIMING_RULES``), and asserts the healthy-request success rate
+(``--min_success_rate``; exit 1 below it):
+
+    python tools/serve_loadgen.py --inproc --tiny --requests 8 \
+        --faults 'fail@2,unavail@4-5' --min_success_rate 0.5
 """
 
 from __future__ import annotations
@@ -67,6 +80,21 @@ class _InprocTarget:
         return rec
 
 
+def _is_shed(exc: Exception) -> bool:
+    """Was this submit load-shed (429) or fast-failed unavailable (503)?
+    Sheds are the backpressure layer working as designed — counted apart
+    from genuine errors."""
+    try:
+        from videop2p_tpu.serve.faults import EngineUnavailable, QueueFull
+
+        if isinstance(exc, (QueueFull, EngineUnavailable)):
+            return True
+    except ImportError:
+        pass
+    msg = str(exc)
+    return "HTTP 429" in msg or "HTTP 503" in msg
+
+
 def run_loadgen(
     target,
     request: Dict[str, Any],
@@ -75,10 +103,15 @@ def run_loadgen(
     concurrency: int,
     ledger_path: Optional[str] = None,
     meta: Optional[Dict[str, Any]] = None,
+    collect_extra=None,
 ) -> Dict[str, Any]:
     """Run the closed loop; returns the summary record (also printed as one
     JSON line by :func:`main`). When ``ledger_path`` is given, the
-    reservoirs flush there as ``execute_timing`` events."""
+    reservoirs flush there as ``execute_timing`` events. ``collect_extra``
+    (chaos mode) is called after the loop and may return extra ledger
+    events (dicts with an ``"event"`` key — the engine's ``fault`` /
+    ``breaker`` trail and its ``serve_health`` summary) to write into the
+    same ledger, making the run's reliability obs_diff-gateable."""
     from videop2p_tpu.obs.timing import LatencyReservoir
 
     reservoirs = {
@@ -86,7 +119,8 @@ def run_loadgen(
         "loadgen_submit": LatencyReservoir(),
     }
     lock = threading.Lock()
-    counters = {"done": 0, "errors": 0, "store_hits": 0, "issued": 0}
+    counters = {"done": 0, "errors": 0, "deadline_exceeded": 0, "shed": 0,
+                "store_hits": 0, "issued": 0}
 
     def worker():
         while True:
@@ -98,14 +132,17 @@ def run_loadgen(
                 rec = target.one(dict(request))
             except Exception as e:  # noqa: BLE001 — a failed request is a counter, not a crash
                 with lock:
-                    counters["errors"] += 1
+                    counters["shed" if _is_shed(e) else "errors"] += 1
                 print(f"[loadgen] request failed: {e}", file=sys.stderr)
                 continue
             with lock:
-                if rec.get("status") == "done":
+                status = rec.get("status")
+                if status == "done":
                     counters["done"] += 1
                     if rec.get("store_hit"):
                         counters["store_hits"] += 1
+                elif status == "deadline_exceeded":
+                    counters["deadline_exceeded"] += 1
                 else:
                     counters["errors"] += 1
             reservoirs["loadgen_request"].add(rec["_e2e_s"], rec["_e2e_s"])
@@ -122,16 +159,28 @@ def run_loadgen(
 
     summaries = {name: res.summary() for name, res in reservoirs.items()
                  if res.summary()}
+    # sheds are correct backpressure, not failures — the success rate is
+    # over the requests the engine actually accepted
+    accepted = max(requests - counters["shed"], 1)
     record = {
         "requests": requests,
         "concurrency": concurrency,
         "done": counters["done"],
         "errors": counters["errors"],
+        "deadline_exceeded": counters["deadline_exceeded"],
+        "shed": counters["shed"],
         "store_hits": counters["store_hits"],
+        "success_rate": round(counters["done"] / accepted, 4),
         "wall_s": round(wall_s, 4),
         "throughput_rps": round(counters["done"] / wall_s, 4) if wall_s else None,
         "latency": summaries.get("loadgen_request"),
     }
+    extra_events = []
+    if collect_extra is not None:
+        try:
+            extra_events = list(collect_extra(record) or [])
+        except Exception as e:  # noqa: BLE001 — chaos bookkeeping must not fail the run
+            print(f"[loadgen] collect_extra failed: {e}", file=sys.stderr)
     if ledger_path:
         from videop2p_tpu.obs import RunLedger
 
@@ -143,6 +192,9 @@ def run_loadgen(
         for name, res in reservoirs.items():
             for d, b in res.samples():
                 led.record_execute(name, d, b)
+        for e in extra_events:
+            ev = dict(e)
+            led.event(ev.pop("event", "fault"), **ev)
         led.event("loadgen_summary", **{k: v for k, v in record.items()
                                         if k != "latency"})
         led.close()  # flushes execute_timing events
@@ -177,7 +229,30 @@ def main(argv=None) -> int:
     ap.add_argument("--width", type=int, default=512)
     ap.add_argument("--checkpoint", type=str, default=None)
     ap.add_argument("--max_batch", type=int, default=4)
+    # chaos mode (ISSUE 9): deterministic fault injection + resilience knobs
+    ap.add_argument("--faults", type=str, default=None,
+                    help="fault plan (serve/faults.py DSL: fail@K, "
+                         "hang@K:S, unavail@A-B, corrupt:PAT) injected into "
+                         "the --inproc engine; the engine's fault/breaker "
+                         "events and serve_health summary land in the "
+                         "loadgen ledger")
+    ap.add_argument("--min_success_rate", type=float, default=None,
+                    help="exit 1 when done/(requests-shed) falls below "
+                         "this; default 0.5 in chaos mode, else the legacy "
+                         "errors!=0 rule")
+    ap.add_argument("--deadline_s", type=float, default=None,
+                    help="default per-request deadline for the --inproc "
+                         "engine")
+    ap.add_argument("--dispatch_timeout_s", type=float, default=None)
+    ap.add_argument("--max_retries", type=int, default=2)
+    ap.add_argument("--breaker_threshold", type=int, default=3)
+    ap.add_argument("--breaker_open_s", type=float, default=1.0)
+    ap.add_argument("--max_queue", type=int, default=64)
     args = ap.parse_args(argv)
+    if args.faults and args.url:
+        ap.error("--faults injects at the engine seams — use --inproc "
+                 "(a remote engine takes VIDEOP2P_SERVE_FAULTS / "
+                 "cli/serve.py --faults instead)")
 
     request = {
         "image_path": args.image,
@@ -186,25 +261,67 @@ def main(argv=None) -> int:
         "save_name": "loadgen",
     }
     engine = None
+    collect_extra = None
     if args.url:
         target = _HttpTarget(args.url, args.timeout_s)
         meta = {"target": args.url}
+
+        def collect_extra(record, client=target.client):
+            # client-side reliability summary (the remote engine's own
+            # ledger holds the authoritative one); breaker trips read from
+            # the live /metrics when the engine still answers
+            trips = None
+            try:
+                trips = client.metrics().get("breaker", {}).get("trips")
+            except Exception:  # noqa: BLE001 — the engine may be gone
+                pass
+            health = {
+                "event": "serve_health", "requests": record["requests"],
+                "done": record["done"], "errors": record["errors"],
+                "deadline_exceeded": record["deadline_exceeded"],
+                "shed": record["shed"],
+                "error_rate": round(
+                    (record["errors"] + record["deadline_exceeded"])
+                    / max(record["requests"] - record["shed"], 1), 4),
+                "shed_rate": round(
+                    record["shed"] / max(record["requests"], 1), 4),
+            }
+            if trips is not None:
+                health["breaker_trips"] = trips
+            return [health]
     else:
         from videop2p_tpu.cli.common import enable_compile_cache
-        from videop2p_tpu.serve import EditEngine, ProgramSpec
+        from videop2p_tpu.serve import EditEngine, FaultPlan, ProgramSpec
 
         enable_compile_cache()
         tiny = True if args.tiny is None else args.tiny
+        faults = FaultPlan.parse(args.faults) if args.faults else None
         engine = EditEngine(
             ProgramSpec(checkpoint=args.checkpoint, tiny=tiny,
                         steps=args.steps, video_len=args.video_len,
                         width=args.width),
             out_dir="loadgen_out", max_batch=args.max_batch,
+            max_queue=args.max_queue,
+            default_deadline_s=args.deadline_s,
+            dispatch_timeout_s=args.dispatch_timeout_s,
+            max_retries=args.max_retries,
+            breaker_threshold=args.breaker_threshold,
+            breaker_open_s=args.breaker_open_s,
+            faults=faults,
         )
         engine.warm((args.prompt, args.edit_prompt),
                     batch_sizes=(min(2, args.max_batch),))
         target = _InprocTarget(engine, args.timeout_s)
-        meta = {"target": "inproc", "tiny": tiny, "steps": args.steps}
+        meta = {"target": "inproc", "tiny": tiny, "steps": args.steps,
+                "faults": args.faults}
+
+        def collect_extra(record, engine=engine):
+            # the engine's own fault/breaker trail + reliability summary —
+            # written into the loadgen ledger so ONE file gates both the
+            # latency (TIMING_RULES) and the reliability (FAULT_RULES)
+            return [dict(e) for e in engine.fault_log] + [
+                {"event": "serve_health", **engine.health_record()}
+            ]
 
     if args.distinct_seeds:
         # closed-loop cold traffic: unique seed per request index
@@ -225,11 +342,21 @@ def main(argv=None) -> int:
             target, request,
             requests=args.requests, concurrency=args.concurrency,
             ledger_path=args.ledger, meta=meta,
+            collect_extra=collect_extra,
         )
     finally:
         if engine is not None:
             engine.close()
     print(json.dumps(record, default=str))
+    min_rate = args.min_success_rate
+    if min_rate is None and args.faults:
+        min_rate = 0.5  # chaos default: doomed requests expected, most survive
+    if min_rate is not None:
+        ok = record["success_rate"] >= min_rate
+        if not ok:
+            print(f"[loadgen] success_rate {record['success_rate']} < "
+                  f"required {min_rate}", file=sys.stderr)
+        return 0 if ok else 1
     return 1 if record["errors"] else 0
 
 

@@ -16,6 +16,8 @@ import argparse
 import contextlib
 import json
 import os
+import signal
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
@@ -53,6 +55,40 @@ from videop2p_tpu.train import (
 from videop2p_tpu.utils.metrics import MetricsLogger
 from videop2p_tpu.utils.profiling import phase_timer
 from videop2p_tpu.utils.video_io import save_videos_grid
+
+# preemption safety (ISSUE 9 satellite): SIGTERM/SIGINT set this event; the
+# training loop checks it at every chunk boundary, saves a final checkpoint
+# through the existing train/checkpoint.py machinery and exits cleanly.
+# Auto-resume (`resume_from_checkpoint: latest`) then continues
+# BIT-IDENTICALLY: per-step noise keys derive from (run key, absolute step)
+# inside train_steps, so the resume boundary cannot change the noise
+# sequence — tests/test_train.py pins interrupted+resumed == uninterrupted.
+_PREEMPT_EVENT = threading.Event()
+
+
+def _preempt_handler(signum, frame):
+    _PREEMPT_EVENT.set()
+
+
+def _install_preempt_handlers():
+    """Install SIGTERM/SIGINT → checkpoint-then-exit; returns a restore
+    callable. No-op off the main thread (the signal API restriction) —
+    embedded callers keep their own handlers."""
+    if threading.current_thread() is not threading.main_thread():
+        return lambda: None
+    prev = {}
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            prev[sig] = signal.signal(sig, _preempt_handler)
+        except (ValueError, OSError):  # exotic embeddings
+            continue
+    def _restore():
+        for sig, h in prev.items():
+            try:
+                signal.signal(sig, h)
+            except (ValueError, OSError):
+                continue
+    return _restore
 
 
 def main(
@@ -290,6 +326,8 @@ def main(
     key, train_key = jax.random.split(key)
     i = first_step
     traced_chunk = False
+    preempted = False
+    restore_signals = _install_preempt_handlers()
     while i < max_train_steps:
         nxt = min(
             [max_train_steps, i + steps_per_call]
@@ -319,6 +357,12 @@ def main(
         losses.append(chunk_losses)  # device-side; no per-chunk host sync
         first_chunk = i == first_step
         i = nxt
+        if _PREEMPT_EVENT.is_set():
+            # SIGTERM/SIGINT landed: save the final checkpoint at this
+            # chunk boundary and exit cleanly (skip validation/export —
+            # the resumed run redoes them); handled after the loop
+            preempted = True
+            break
         if (log_every and i % log_every == 0) or i == max_train_steps or first_chunk:
             loss = flush_losses(i)
             rate = (i - first_step) / max(time.perf_counter() - t0, 1e-9)
@@ -332,6 +376,18 @@ def main(
                 dependent_weights=dependent_weights, sampler=sampler,
                 text_emb=text_emb, key=key,
             )
+    restore_signals()
+    if preempted:
+        if losses:
+            flush_losses(i)
+        metrics.close()
+        ckpt_path = save_checkpoint(output_dir, jax.device_get(state), i)
+        print(f"[tune] preempted at step {i} — checkpoint saved to "
+              f"{ckpt_path}; resume with resume_from_checkpoint: latest")
+        if run_ledger is not None:
+            run_ledger.event("preempted", step=i, checkpoint=ckpt_path)
+            run_ledger.close()
+        return output_dir
     if losses:  # flush the tail of the buffer
         flush_losses(max_train_steps)
     metrics.close()

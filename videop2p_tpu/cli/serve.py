@@ -65,6 +65,37 @@ def build_parser() -> argparse.ArgumentParser:
                          "20 8): exact timestep subsets of --steps served "
                          "from the SAME inversion products; per-request "
                          "'steps' outside the warmed buckets is a 400")
+    # resilience knobs (ISSUE 9 — docs/SERVING.md "Failure semantics")
+    ap.add_argument("--max_queue", type=int, default=64,
+                    help="bounded admit queue: over this many in-flight "
+                         "requests, submits shed with HTTP 429")
+    ap.add_argument("--deadline_s", type=float, default=None,
+                    help="default per-request deadline (seconds from "
+                         "submit); expired requests fail with terminal "
+                         "status deadline_exceeded")
+    ap.add_argument("--dispatch_timeout_s", type=float, default=None,
+                    help="watchdog budget around each device dispatch: past "
+                         "it the batch fails deadline_exceeded instead of "
+                         "wedging the engine")
+    ap.add_argument("--max_retries", type=int, default=2,
+                    help="transient dispatch failures retry this many times "
+                         "(capped jitter-free exponential backoff)")
+    ap.add_argument("--breaker_threshold", type=int, default=3,
+                    help="consecutive dispatch failures that trip the "
+                         "circuit breaker open (submits then fast-fail 503 "
+                         "with Retry-After)")
+    ap.add_argument("--breaker_open_s", type=float, default=5.0,
+                    help="open-window seconds before the breaker half-opens "
+                         "for its recovery probe")
+    ap.add_argument("--drain_s", type=float, default=5.0,
+                    help="graceful-shutdown window: SIGTERM/SIGINT stops "
+                         "admitting and gives queued work this long before "
+                         "failing leftovers with engine_closed")
+    ap.add_argument("--faults", type=str, default=None,
+                    help="deterministic fault-injection plan (serve/faults"
+                         ".py DSL, e.g. 'fail@2,hang@4:1.5,unavail@5-7,"
+                         "corrupt:*'); also via VIDEOP2P_SERVE_FAULTS — "
+                         "chaos testing only")
     return ap
 
 
@@ -74,7 +105,7 @@ def main(argv=None) -> int:
     from videop2p_tpu.parallel import initialize_distributed
 
     initialize_distributed()
-    from videop2p_tpu.serve import EditEngine, ProgramSpec
+    from videop2p_tpu.serve import EditEngine, FaultPlan, ProgramSpec
     from videop2p_tpu.serve.http import make_server
 
     spec = ProgramSpec(
@@ -83,6 +114,9 @@ def main(argv=None) -> int:
         guidance_scale=args.guidance_scale, tiny=args.tiny,
         mixed_precision=args.mixed_precision, seed=args.seed, mesh=args.mesh,
     )
+    faults = FaultPlan.parse(args.faults) if args.faults else None
+    if faults is not None:
+        print(f"[serve] CHAOS MODE: injecting fault plan {args.faults!r}")
     engine = EditEngine(
         spec,
         out_dir=args.out_dir,
@@ -92,6 +126,13 @@ def main(argv=None) -> int:
         max_wait_s=args.max_wait_ms / 1000.0,
         batch_dispatch=args.batch_dispatch,
         ledger_path=args.ledger,
+        max_queue=args.max_queue,
+        default_deadline_s=args.deadline_s,
+        dispatch_timeout_s=args.dispatch_timeout_s,
+        max_retries=args.max_retries,
+        breaker_threshold=args.breaker_threshold,
+        breaker_open_s=args.breaker_open_s,
+        faults=faults,
     )
     if not args.no_warm:
         print(f"[serve] warming programs (spec {engine.spec.fingerprint()})...")
@@ -104,13 +145,31 @@ def main(argv=None) -> int:
     server = make_server(engine, host=args.host, port=args.port)
     print(f"[serve] listening on {server.url}  "
           f"(ledger: {engine.ledger.path})")
+
+    # graceful drain-then-exit on SIGTERM (the orchestrator's preemption
+    # signal): stop the HTTP loop from a helper thread — calling shutdown()
+    # inside the handler would deadlock, the handler runs ON the thread
+    # serve_forever is blocking — then the finally below drains the engine
+    # (in-flight work gets --drain_s to finish; leftovers fail with the
+    # terminal engine_closed status instead of hanging clients forever)
+    import signal
+    import threading
+
+    def _sigterm(signum, frame):
+        print("[serve] SIGTERM — draining")
+        threading.Thread(target=server.httpd.shutdown, daemon=True).start()
+
+    try:
+        signal.signal(signal.SIGTERM, _sigterm)
+    except ValueError:  # not the main thread (embedded use) — skip
+        pass
     try:
         server.serve_forever()
     except KeyboardInterrupt:
         print("[serve] shutting down")
     finally:
         server.httpd.server_close()
-        engine.close()
+        engine.close(drain_s=args.drain_s)
     return 0
 
 

@@ -77,6 +77,7 @@ from videop2p_tpu.obs.comm import (
 from videop2p_tpu.obs.history import (
     COMM_RULES,
     DEFAULT_RULES,
+    FAULT_RULES,
     QUALITY_RULES,
     TIMING_RULES,
     RegressionRule,
@@ -163,6 +164,7 @@ __all__ = [
     "QUALITY_RULES",
     "COMM_RULES",
     "TIMING_RULES",
+    "FAULT_RULES",
     "EXECUTE_TIMING_FIELDS",
     "LatencyReservoir",
     "latency_enabled",

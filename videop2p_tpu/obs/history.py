@@ -40,6 +40,7 @@ __all__ = [
     "QUALITY_RULES",
     "COMM_RULES",
     "TIMING_RULES",
+    "FAULT_RULES",
     "split_runs",
     "extract_run",
     "evaluate_rules",
@@ -60,7 +61,9 @@ class RegressionRule:
     ``quality`` ledger event — PSNR/SSIM), ``"comm"`` (collective
     counts/bytes from ``comm_analysis`` events), ``"device_memory"``
     (per-device peak HBM from ``memory`` snapshots), ``"divergence"``
-    (cross-replica divergence scalars). ``min_abs`` suppresses verdicts
+    (cross-replica divergence scalars), ``"reliability"`` (serving-health
+    summaries from ``serve_health`` events — error/shed rates, breaker
+    trips). ``min_abs`` suppresses verdicts
     whose absolute delta is noise-sized (a 0.001 s phase doubling is not a
     regression). ``programs`` (labels for program/compile/dispatch kinds,
     phase names for phases) restricts the rule; None applies it everywhere.
@@ -134,6 +137,24 @@ TIMING_RULES: Tuple[RegressionRule, ...] = (
                    threshold_pct=10.0, min_abs=0.02),
 )
 
+# reliability gates (ISSUE 9): the serving resilience layer's health
+# summary (`serve_health` ledger events — engine close / chaos loadgen)
+# regresses like perf: the error rate climbing, load-shedding appearing,
+# the circuit breaker tripping or deadlines expiring where the baseline
+# had none. threshold_pct=0 + a 0.5 absolute floor makes the count rules
+# "any new incident regresses" while identical runs still self-compare
+# clean (a 0-delta is never > 0); rates get small absolute noise floors.
+FAULT_RULES: Tuple[RegressionRule, ...] = (
+    RegressionRule("error_rate", kind="reliability", threshold_pct=10.0,
+                   min_abs=0.01),
+    RegressionRule("shed_rate", kind="reliability", threshold_pct=10.0,
+                   min_abs=0.01),
+    RegressionRule("breaker_trips", kind="reliability", threshold_pct=0.0,
+                   min_abs=0.5),
+    RegressionRule("deadline_exceeded", kind="reliability",
+                   threshold_pct=0.0, min_abs=0.5),
+)
+
 DEFAULT_RULES: Tuple[RegressionRule, ...] = (
     RegressionRule("flops", threshold_pct=10.0),
     RegressionRule("bytes_accessed", threshold_pct=15.0, min_abs=1 << 20),
@@ -142,7 +163,7 @@ DEFAULT_RULES: Tuple[RegressionRule, ...] = (
     RegressionRule("hlo_instructions", threshold_pct=25.0, min_abs=16),
     RegressionRule("seconds", kind="compile", threshold_pct=50.0, min_abs=1.0),
     RegressionRule("seconds", kind="phase", threshold_pct=25.0, min_abs=0.5),
-) + QUALITY_RULES + COMM_RULES + TIMING_RULES
+) + QUALITY_RULES + COMM_RULES + TIMING_RULES + FAULT_RULES
 
 
 def split_runs(events: Iterable[Dict[str, Any]]) -> List[List[Dict[str, Any]]]:
@@ -190,6 +211,8 @@ def extract_run(events: Sequence[Dict[str, Any]],
         # time-domain sections (ISSUE 6) — likewise empty pre-PR-6
         "timing": {},
         "trace": {},
+        # reliability section (ISSUE 9) — likewise empty pre-PR-9
+        "reliability": {},
     }
     for e in events:
         kind = e.get("event")
@@ -280,6 +303,15 @@ def extract_run(events: Sequence[Dict[str, Any]],
                              "families", "top_ops")
                 and isinstance(v, (int, float)) and not isinstance(v, bool)
             }
+        elif kind == "serve_health":
+            # one summary per engine/loadgen session; a later summary in
+            # the same run supersedes (reopened engine over one ledger)
+            label = e.get("label") or "serve"
+            rec["reliability"][label] = {
+                k: float(v) for k, v in e.items()
+                if k not in ("event", "t", "label")
+                and isinstance(v, (int, float)) and not isinstance(v, bool)
+            }
         elif kind == "device_telemetry":
             # the in-scan probe's worst divergence joins the same gate
             label = e.get("program") or "(unattributed)"
@@ -322,7 +354,7 @@ def _rule_values(record: Dict[str, Any], rule: RegressionRule) -> Dict[str, floa
                    for k, v in record.get("device_memory", {}).items()}
     elif rule.kind == "divergence":
         out = {k: float(v) for k, v in record.get("divergence", {}).items()}
-    elif rule.kind in ("timing", "trace"):
+    elif rule.kind in ("timing", "trace", "reliability"):
         for label, m in record.get(rule.kind, {}).items():
             if rule.metric in m:
                 out[label] = float(m[rule.metric])

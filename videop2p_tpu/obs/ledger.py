@@ -210,9 +210,10 @@ class RunLedger:
 
     # ---- event writing ---------------------------------------------------
 
-    def event(self, kind: str, **fields: Any) -> None:
+    def event(self, kind: str, /, **fields: Any) -> None:
         """Append one event; never raises (a full disk or closed handle
-        must not take the run down with it)."""
+        must not take the run down with it). ``kind`` is positional-only
+        so a field may itself be named ``kind`` (the ``fault`` events)."""
         rec = {"event": kind, "t": round(time.perf_counter() - self._t0, 4)}
         rec.update(fields)
         try:
@@ -264,6 +265,20 @@ class RunLedger:
         (obs.comm.replica_divergence) — must be 0.0; the COMM_RULES
         verdict has a zero noise floor."""
         self.event("divergence", label=label, value=float(value), **fields)
+
+    def fault(self, kind: str, **fields: Any) -> None:
+        """Record one fault observation (ISSUE 9): an injected fault
+        firing (serve/faults.py FaultPlan), a retry, a watchdog timeout —
+        anything the resilience layer absorbed or failed on. The
+        end-of-run ``serve_health`` summary is what FAULT_RULES gate;
+        these events are the per-incident trail."""
+        self.event("fault", kind=kind, **fields)
+
+    def breaker(self, state_from: str, state_to: str, **fields: Any) -> None:
+        """Record one circuit-breaker transition (closed → open →
+        half-open; serve/faults.py CircuitBreaker)."""
+        self.event("breaker", state_from=state_from, state_to=state_to,
+                   **fields)
 
     def timing_enabled(self) -> bool:
         """True when per-dispatch execute timing is on for this run —

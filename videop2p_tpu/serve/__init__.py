@@ -23,6 +23,11 @@ DDIM inversions per edit of the same clip. This package keeps both warm:
   * :mod:`videop2p_tpu.serve.http` / :mod:`videop2p_tpu.serve.client` —
     the stdlib JSON API (``cli/serve.py`` is the entry point) and its
     urllib client (the UI's engine-backed path; ``tools/serve_loadgen.py``).
+  * :mod:`videop2p_tpu.serve.faults` — the resilience layer's primitives
+    (ISSUE 9): deterministic fault injection (:class:`FaultPlan`), the
+    jitter-free :class:`RetryPolicy`, the :class:`CircuitBreaker`, and the
+    machine-readable fast-fail exceptions the HTTP layer maps to
+    429/503/``Retry-After``.
 
 Import contract: stdlib + numpy + jax (+ the package itself) only — the
 same guard as ``obs/`` (tests/test_bench_guard.py walks this package).
@@ -37,7 +42,16 @@ from videop2p_tpu.serve.batching import (
     unstack_outputs,
 )
 from videop2p_tpu.serve.client import EngineClient, engine_available
-from videop2p_tpu.serve.engine import EditEngine, EditRequest
+from videop2p_tpu.serve.engine import TERMINAL_STATUSES, EditEngine, EditRequest
+from videop2p_tpu.serve.faults import (
+    CircuitBreaker,
+    DeadlineExceeded,
+    EngineUnavailable,
+    FaultPlan,
+    QueueFull,
+    RetryPolicy,
+    is_transient,
+)
 from videop2p_tpu.serve.programs import ProgramCache, ProgramSet, ProgramSpec
 from videop2p_tpu.serve.store import (
     InversionStore,
@@ -56,6 +70,14 @@ __all__ = [
     "engine_available",
     "EditEngine",
     "EditRequest",
+    "TERMINAL_STATUSES",
+    "CircuitBreaker",
+    "DeadlineExceeded",
+    "EngineUnavailable",
+    "FaultPlan",
+    "QueueFull",
+    "RetryPolicy",
+    "is_transient",
     "ProgramCache",
     "ProgramSet",
     "ProgramSpec",

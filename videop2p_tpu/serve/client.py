@@ -4,6 +4,13 @@ The thin urllib counterpart of :mod:`videop2p_tpu.serve.http` — the demo
 UI's engine-backed path, ``tools/serve_loadgen.py`` and scripts talk to a
 running ``cli/serve.py`` through this. No third-party HTTP stack; the
 import-guard test walks this package.
+
+Retry-aware (ISSUE 9): an overloaded (**429**, load shed) or degraded
+(**503**, circuit breaker open / shutting down) engine answers with
+machine-readable fast-fails — the client backs off for the server's
+``Retry-After`` hint (capped; deterministic exponential fallback when the
+header is absent) and retries up to ``retries`` times before raising.
+Other statuses (400/404/500) never retry — they would fail identically.
 """
 
 from __future__ import annotations
@@ -16,15 +23,42 @@ from typing import Any, Dict, Optional
 
 __all__ = ["EngineClient", "engine_available"]
 
+# the fast-fail statuses worth retrying: the server TOLD us to come back
+_RETRYABLE = (429, 503)
+
 
 class EngineClient:
-    """JSON client over the ``/v1/edits`` + ``/healthz`` + ``/metrics`` API."""
+    """JSON client over the ``/v1/edits`` + ``/healthz`` + ``/metrics`` API.
 
-    def __init__(self, base_url: str, *, timeout_s: float = 10.0):
+    ``retries``/``backoff_s``/``backoff_cap_s`` bound the deterministic
+    retry schedule for 429/503 answers (``retries=0`` restores fail-fast).
+    """
+
+    def __init__(self, base_url: str, *, timeout_s: float = 10.0,
+                 retries: int = 2, backoff_s: float = 0.25,
+                 backoff_cap_s: float = 5.0):
         self.base_url = base_url.rstrip("/")
         self.timeout_s = float(timeout_s)
+        self.retries = max(int(retries), 0)
+        self.backoff_s = float(backoff_s)
+        self.backoff_cap_s = float(backoff_cap_s)
 
     # ---- plumbing --------------------------------------------------------
+
+    def _retry_delay_s(self, attempt: int,
+                       retry_after: Optional[str]) -> float:
+        """The server's Retry-After hint when parseable, else the capped
+        jitter-free exponential fallback — both bounded by the cap so a
+        pathological header cannot stall a client."""
+        delay = None
+        if retry_after:
+            try:
+                delay = float(retry_after)
+            except ValueError:
+                delay = None
+        if delay is None:
+            delay = self.backoff_s * (2.0 ** attempt)
+        return min(max(delay, 0.0), self.backoff_cap_s)
 
     def _request(self, path: str, payload: Optional[Dict] = None,
                  timeout_s: Optional[float] = None) -> Dict[str, Any]:
@@ -33,22 +67,30 @@ class EngineClient:
         if payload is not None:
             data = json.dumps(payload).encode()
             headers["Content-Type"] = "application/json"
-        req = urllib.request.Request(
-            self.base_url + path, data=data, headers=headers
-        )
-        try:
-            with urllib.request.urlopen(
-                req, timeout=timeout_s or self.timeout_s
-            ) as resp:
-                return json.loads(resp.read() or b"{}")
-        except urllib.error.HTTPError as e:
+        attempt = 0
+        while True:
+            req = urllib.request.Request(
+                self.base_url + path, data=data, headers=headers
+            )
             try:
-                detail = json.loads(e.read() or b"{}").get("error", "")
-            except ValueError:
-                detail = ""
-            raise RuntimeError(
-                f"{path} failed with HTTP {e.code}: {detail or e.reason}"
-            ) from e
+                with urllib.request.urlopen(
+                    req, timeout=timeout_s or self.timeout_s
+                ) as resp:
+                    return json.loads(resp.read() or b"{}")
+            except urllib.error.HTTPError as e:
+                try:
+                    detail = json.loads(e.read() or b"{}").get("error", "")
+                except ValueError:
+                    detail = ""
+                if e.code in _RETRYABLE and attempt < self.retries:
+                    time.sleep(self._retry_delay_s(
+                        attempt, e.headers.get("Retry-After")
+                    ))
+                    attempt += 1
+                    continue
+                raise RuntimeError(
+                    f"{path} failed with HTTP {e.code}: {detail or e.reason}"
+                ) from e
 
     # ---- API -------------------------------------------------------------
 
@@ -74,12 +116,16 @@ class EngineClient:
 
     def wait(self, rid: str, *, timeout_s: float = 600.0,
              poll_interval_s: float = 0.25) -> Dict[str, Any]:
-        """Client-side wait loop until the record is terminal; raises
+        """Client-side wait loop until the record is terminal (``done`` /
+        ``error`` / ``deadline_exceeded`` / ``engine_closed``); raises
         TimeoutError when the deadline passes first."""
+        # mirrors engine.TERMINAL_STATUSES (not imported: the client must
+        # stay importable without jax; test_faults pins the two in sync)
+        terminal = ("done", "error", "deadline_exceeded", "engine_closed")
         deadline = time.perf_counter() + float(timeout_s)
         while True:
             rec = self.poll(rid)
-            if rec.get("status") in ("done", "error"):
+            if rec.get("status") in terminal:
                 return rec
             if time.perf_counter() >= deadline:
                 raise TimeoutError(

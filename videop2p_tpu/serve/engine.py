@@ -4,20 +4,47 @@ Request lifecycle (one worker thread owns every device dispatch, so JAX
 program order is deterministic and the HTTP layer never touches devices):
 
   admit → resolve (controller + content-addressed inversion-store lookup;
-  a miss runs VAE encode + capture-inversion ONCE per clip and stores the
-  products device-resident) → batch (compatible concurrent requests group
-  into one dispatch, :mod:`videop2p_tpu.serve.batching`) → dispatch (the
-  warm ``serve_edit`` program: cached-source controlled edit + VAE decode)
+  a miss first tries LAZY REHYDRATION from the store's disk layer — a
+  restarted engine rebuilds the device products from the persisted
+  trajectory through its warm inversion program, no frame IO / VAE encode
+  / cold compile — and only then runs VAE encode + capture-inversion ONCE
+  per clip) → batch (compatible concurrent requests group into one
+  dispatch, :mod:`videop2p_tpu.serve.batching`) → dispatch (the warm
+  ``serve_edit`` program: cached-source controlled edit + VAE decode)
   → artifacts (GIFs) + per-request verdicts (``src_err``, compile-event
   delta, store hit).
+
+Resilience layer (ISSUE 9 — see ``docs/SERVING.md`` "Failure semantics"):
+
+  * **deadlines** — per-request ``deadline_s`` admitted at submit; an
+    expired request fails with terminal status ``deadline_exceeded``
+    before any further device work is spent on it.
+  * **watchdog** — the worker's device dispatch runs under a bounded
+    block-until-ready (``dispatch_timeout_s`` and/or the batch's tightest
+    remaining deadline); a dispatch that exceeds its budget fails the
+    batch with ``deadline_exceeded`` instead of wedging the engine — the
+    worker abandons the stuck thread and keeps serving.
+  * **retry + circuit breaker** — transient dispatch failures retry on a
+    capped, jitter-free exponential schedule
+    (:class:`~videop2p_tpu.serve.faults.RetryPolicy`); consecutive batch
+    failures trip the :class:`~videop2p_tpu.serve.faults.CircuitBreaker`
+    (closed → open → half-open): while open, submits fast-fail 503 with
+    ``Retry-After`` and ``/healthz`` reports ``degraded``; recovery is
+    automatic when the half-open probe dispatch succeeds.
+  * **backpressure** — a bounded admit queue (``max_queue`` in-flight);
+    over it, submits raise :class:`~videop2p_tpu.serve.faults.QueueFull`
+    (HTTP 429 with the queue depth in the body).
+  * **fault injection** — a deterministic
+    :class:`~videop2p_tpu.serve.faults.FaultPlan` threads through the
+    dispatch and store seams so every behavior above is testable on CPU.
 
 Observability is the live run ledger: the engine owns an activated
 :class:`~videop2p_tpu.obs.RunLedger` with execute timing ON, so every
 program dispatch lands in the per-program latency reservoirs
-(:mod:`videop2p_tpu.obs.timing`) and every compile is attributed — the
-``/metrics`` endpoint reads those reservoirs directly (p50/p95/p99 per
-program and per request-phase) and the ledger file is diffable with
-``tools/obs_diff.py`` like any other run's.
+(:mod:`videop2p_tpu.obs.timing`), every compile is attributed, and every
+injected fault / breaker transition becomes a ``fault`` / ``breaker``
+event; closing the engine writes one ``serve_health`` summary gated by
+``FAULT_RULES`` through ``tools/obs_diff.py`` like any other run record.
 
 Stdlib+numpy+jax only — the import-guard test walks this package.
 """
@@ -34,6 +61,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from videop2p_tpu.serve.batching import (
@@ -42,16 +70,35 @@ from videop2p_tpu.serve.batching import (
     stack_items,
     unstack_outputs,
 )
+from videop2p_tpu.serve.faults import (
+    CircuitBreaker,
+    DeadlineExceeded,
+    EngineUnavailable,
+    FaultPlan,
+    QueueFull,
+    RetryPolicy,
+    is_transient,
+)
 from videop2p_tpu.serve.programs import ProgramSet, ProgramSpec
 from videop2p_tpu.serve.store import InversionStore
 
-__all__ = ["EditRequest", "EditEngine"]
+__all__ = ["EditRequest", "EditEngine", "TERMINAL_STATUSES"]
 
 _REQUEST_FIELDS = (
     "image_path", "prompt", "prompts", "save_name", "is_word_swap",
     "blend_word", "eq_params", "cross_replace_steps", "self_replace_steps",
-    "seed", "steps",
+    "seed", "steps", "deadline_s",
 )
+
+# the machine-readable terminal statuses — everything else is in flight.
+# "error": the engine gave up on the request (resolve failure, retries
+# exhausted); "deadline_exceeded": its budget expired (queued too long or
+# the dispatch watchdog fired); "engine_closed": close() drained it.
+TERMINAL_STATUSES = ("done", "error", "deadline_exceeded", "engine_closed")
+
+# bounded in-memory mirror of the fault/breaker ledger events — /metrics
+# and the chaos loadgen read it without re-parsing the ledger file
+_FAULT_LOG_MAX = 256
 
 
 @dataclass
@@ -78,6 +125,11 @@ class EditRequest:
     # the engine rejects unknown step geometry at admission (HTTP 400)
     # rather than compiling cold mid-serve.
     steps: Optional[int] = None
+    # per-request latency budget in seconds, measured from submit: the
+    # request fails with terminal status "deadline_exceeded" once it
+    # expires (queued, resolving or mid-dispatch — the dispatch watchdog
+    # bounds the block-until-ready). None = the engine default.
+    deadline_s: Optional[float] = None
     frames: Optional[np.ndarray] = None
 
     @classmethod
@@ -104,6 +156,13 @@ class EditRequest:
         if self.steps is not None and (not isinstance(self.steps, int)
                                        or self.steps < 1):
             raise ValueError(f"'steps' must be a positive int, got {self.steps!r}")
+        if self.deadline_s is not None and (
+            not isinstance(self.deadline_s, (int, float))
+            or isinstance(self.deadline_s, bool) or self.deadline_s <= 0
+        ):
+            raise ValueError(
+                f"'deadline_s' must be positive seconds, got {self.deadline_s!r}"
+            )
 
 
 @dataclass
@@ -133,6 +192,16 @@ class EditEngine:
         ledger_path: Optional[str] = None,
         keep_videos: bool = False,
         programs: Optional[ProgramSet] = None,
+        # resilience knobs (docs/SERVING.md "Failure semantics")
+        max_queue: int = 64,
+        default_deadline_s: Optional[float] = None,
+        dispatch_timeout_s: Optional[float] = None,
+        max_retries: int = 2,
+        retry_base_s: float = 0.05,
+        retry_cap_s: float = 2.0,
+        breaker_threshold: int = 3,
+        breaker_open_s: float = 5.0,
+        faults: Optional[FaultPlan] = None,
     ):
         from videop2p_tpu.cli.common import make_run_ledger
 
@@ -142,25 +211,46 @@ class EditEngine:
         self.max_wait_s = float(max_wait_s)
         self.batch_dispatch = batch_dispatch
         self.keep_videos = bool(keep_videos)
+        self.max_queue = max(int(max_queue), 1)
+        self.default_deadline_s = default_deadline_s
+        self.dispatch_timeout_s = dispatch_timeout_s
+        self.retry = RetryPolicy(max_retries=max_retries, base_s=retry_base_s,
+                                 cap_s=retry_cap_s)
+        self.breaker = CircuitBreaker(threshold=breaker_threshold,
+                                      open_s=breaker_open_s,
+                                      on_transition=self._on_breaker)
+        self.faults = faults if faults is not None else FaultPlan.from_env()
         self.ledger = make_run_ledger(
             ledger_path or os.path.join(out_dir, "serve_ledger.jsonl"),
             enable=True, latency=True, set_latency_env=False,
-            meta={"cli": "serve", "spec": dict(spec.resolved().__dict__)},
+            meta={"cli": "serve", "spec": dict(spec.resolved().__dict__),
+                  "faults": getattr(self.faults, "spec", None)},
             mesh=spec.mesh,
         )
+        self.fault_log: List[Dict[str, Any]] = []
+        self.counters: Dict[str, int] = {
+            "shed": 0, "rejected_unavailable": 0, "retries": 0,
+            "faults_injected": 0, "rehydrations": 0, "fresh_inversions": 0,
+        }
+        self._counter_lock = threading.Lock()
+        if self.faults is not None:
+            self.faults.on_inject = self._fault_event
         self.programs = programs if programs is not None else ProgramSet(spec)
         self.spec = self.programs.spec
         # per-request `steps` is admitted only against this set — unknown
         # step geometry is a 400 at submit, never a cold compile mid-serve
         self.warm_steps = {self.spec.steps}
-        self.store = InversionStore(store_budget_bytes, persist_dir=persist_dir)
+        self.store = InversionStore(store_budget_bytes, persist_dir=persist_dir,
+                                    faults=self.faults)
         self._spec_fp = self.spec.fingerprint()
         self._requests: Dict[str, Dict[str, Any]] = {}
         self._videos: Dict[str, np.ndarray] = {}
         self._req_lock = threading.Lock()
+        self._inflight = 0
         self._queue: "queue.Queue" = queue.Queue()
         self._done = threading.Event()
         self._closed = False
+        self._drain_until = float("inf")
         self.started = time.perf_counter()
         self._worker = threading.Thread(
             target=self._worker_loop, name="edit-engine", daemon=True
@@ -188,12 +278,26 @@ class EditEngine:
         return info
 
     def submit(self, request: EditRequest) -> str:
-        """Enqueue one request; returns its id immediately. A per-request
-        ``steps`` outside the warmed buckets raises ``ValueError`` (the
-        HTTP layer's 400) listing the warm list — unknown step geometry
-        must not silently compile cold mid-serve."""
+        """Enqueue one request; returns its id immediately.
+
+        Fast-fail surfaces (each one machine-readable at the HTTP layer):
+        a closed engine or an OPEN circuit breaker raises
+        :class:`EngineUnavailable` (503, ``Retry-After`` = the breaker's
+        remaining open window); a full admit queue raises
+        :class:`QueueFull` (429 with the depth); a per-request ``steps``
+        outside the warmed buckets raises ``ValueError`` (400) listing the
+        warm list — unknown step geometry must not silently compile cold
+        mid-serve."""
         if self._closed:
-            raise RuntimeError("engine is closed")
+            raise EngineUnavailable("engine is closed")
+        if not self.breaker.allow():
+            self._count("rejected_unavailable")
+            raise EngineUnavailable(
+                f"circuit breaker open after "
+                f"{self.breaker.consecutive_failures} consecutive dispatch "
+                "failures — backend presumed unhealthy",
+                retry_after_s=self.breaker.retry_after_s(),
+            )
         request.validate()
         steps = int(request.steps) if request.steps else self.spec.steps
         if steps not in self.warm_steps:
@@ -204,16 +308,30 @@ class EditEngine:
                 "(EditEngine.warm(step_buckets=...) / cli.serve --step_buckets)"
             )
         rid = uuid.uuid4().hex[:12]
+        now = time.perf_counter()
+        deadline_s = (request.deadline_s if request.deadline_s is not None
+                      else self.default_deadline_s)
         rec = {
             "id": rid,
             "status": "queued",
-            "submitted_s": time.perf_counter(),
+            "submitted_s": now,
+            "deadline_s": deadline_s,
+            "deadline_at": (now + float(deadline_s)
+                            if deadline_s is not None else None),
             "request": {k: v for k, v in request.to_dict().items()
                         if k != "frames"},
             "compile_events_before": len(self.ledger.compile_seconds),
         }
         with self._req_lock:
-            self._requests[rid] = rec
+            if self._inflight >= self.max_queue:
+                depth = self._inflight
+            else:
+                depth = None
+                self._requests[rid] = rec
+                self._inflight += 1
+        if depth is not None:
+            self._count("shed")
+            raise QueueFull(depth, self.max_queue)
         self._queue.put((rid, request))
         return rid
 
@@ -231,7 +349,7 @@ class EditEngine:
         deadline = time.perf_counter() + max(float(wait_s), 0.0)
         while True:
             rec = self.poll(rid)
-            if rec["status"] in ("done", "error"):
+            if rec["status"] in TERMINAL_STATUSES:
                 return rec
             if time.perf_counter() >= deadline:
                 return rec
@@ -246,11 +364,13 @@ class EditEngine:
         """The live SLO record ``/metrics`` serves: per-program and
         per-phase latency distributions straight from the ledger's
         reservoirs, compile-vs-execute split, store hit rates, request
-        counts and per-device HBM."""
+        counts, queue-depth / in-flight gauges, the breaker snapshot,
+        resilience counters and per-device HBM."""
         with self._req_lock:
             by_status: Dict[str, int] = {}
             for rec in self._requests.values():
                 by_status[rec["status"]] = by_status.get(rec["status"], 0) + 1
+            in_flight = self._inflight
         timing = self.ledger.execute_timing_summary()
         request_latency = timing.get("serve_request_e2e")
         return {
@@ -258,6 +378,11 @@ class EditEngine:
             "spec_fingerprint": self._spec_fp,
             "warm": self.programs.warmed,
             "requests": by_status,
+            "queue_depth": self._queue.qsize(),
+            "in_flight": in_flight,
+            "max_queue": self.max_queue,
+            "breaker": self.breaker.snapshot(),
+            "counters": dict(self.counters),
             "store": self.store.stats(),
             "compile": {
                 "events": len(self.ledger.compile_seconds),
@@ -268,13 +393,77 @@ class EditEngine:
             "devices": self._device_memory(),
         }
 
-    def close(self) -> None:
-        """Drain, stop the worker, flush execute timing, close the ledger."""
+    def health_record(self) -> Dict[str, Any]:
+        """The ``serve_health`` reliability summary (obs/history.py's
+        ``reliability`` section; gated by ``FAULT_RULES``): request
+        outcomes by terminal status, error/shed rates, breaker trips and
+        the injection/recovery counters."""
+        with self._req_lock:
+            by_status: Dict[str, int] = {}
+            for rec in self._requests.values():
+                by_status[rec["status"]] = by_status.get(rec["status"], 0) + 1
+        admitted = sum(by_status.values())
+        done = by_status.get("done", 0)
+        errors = by_status.get("error", 0)
+        deadline_exceeded = by_status.get("deadline_exceeded", 0)
+        engine_closed = by_status.get("engine_closed", 0)
+        shed = self.counters["shed"]
+        rejected = self.counters["rejected_unavailable"]
+        attempts = admitted + shed + rejected
+        return {
+            "requests": admitted,
+            "done": done,
+            "errors": errors,
+            "deadline_exceeded": deadline_exceeded,
+            "engine_closed": engine_closed,
+            "shed": shed,
+            "rejected_unavailable": rejected,
+            "error_rate": (round((errors + deadline_exceeded) / admitted, 4)
+                           if admitted else 0.0),
+            "shed_rate": (round((shed + rejected) / attempts, 4)
+                          if attempts else 0.0),
+            "breaker_trips": self.breaker.trips,
+            "retries": self.counters["retries"],
+            "faults_injected": self.counters["faults_injected"],
+            "rehydrations": self.counters["rehydrations"],
+            "fresh_inversions": self.counters["fresh_inversions"],
+            "store_corrupt": self.store.disk_corrupt,
+        }
+
+    def close(self, *, drain_s: float = 0.0) -> None:
+        """Stop admitting, stop the worker, and FAIL every still-pending
+        request with terminal status ``engine_closed`` — nothing is ever
+        left ``queued``/``resolving``/``running`` forever. With
+        ``drain_s`` > 0, first give queued work that long to finish (the
+        SIGTERM graceful-drain window in ``cli/serve.py``); the in-flight
+        dispatch always completes either way. Writes the ``serve_health``
+        summary, flushes execute timing and closes the ledger."""
         if self._closed:
             return
         self._closed = True
+        self._drain_until = time.perf_counter() + max(float(drain_s), 0.0)
+        if drain_s > 0:
+            while time.perf_counter() < self._drain_until:
+                with self._req_lock:
+                    if self._inflight == 0:
+                        break
+                time.sleep(0.02)
         self._queue.put(None)
         self._worker.join(timeout=60.0)
+        # drain the queue (items the worker never took) and terminalize
+        # every non-terminal record — incl. any submit that raced close()
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        with self._req_lock:
+            pending = [rid for rid, rec in self._requests.items()
+                       if rec["status"] not in TERMINAL_STATUSES]
+        for rid in pending:
+            self._fail_status(rid, "engine_closed",
+                              "engine closed before completion")
+        self.ledger.event("serve_health", **self.health_record())
         self.ledger.event("serve_shutdown", requests=len(self._requests))
         self.ledger.close()
 
@@ -283,6 +472,36 @@ class EditEngine:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+    # ---- fault / breaker bookkeeping ------------------------------------
+
+    def _count(self, name: str, n: int = 1) -> None:
+        with self._counter_lock:
+            self.counters[name] = self.counters.get(name, 0) + n
+
+    def _fault_event(self, kind: str, **fields: Any) -> None:
+        """One fault observation (injected via the FaultPlan's on_inject
+        callback, or engine-classified): ledger ``fault`` event + the
+        bounded in-memory log + the injection counter."""
+        detail = ", ".join(f"{k}={v}" for k, v in fields.items()) or kind
+        if kind in ("dispatch_fail", "backend_unavailable", "hang",
+                    "store_corrupt"):
+            self._count("faults_injected")
+        entry = {"event": "fault", "kind": kind, "detail": detail}
+        if len(self.fault_log) < _FAULT_LOG_MAX:
+            self.fault_log.append(entry)
+        self.ledger.fault(kind, detail=detail)
+
+    def _on_breaker(self, state_from: str, state_to: str, *,
+                    consecutive_failures: int, trips: int) -> None:
+        entry = {"event": "breaker", "state_from": state_from,
+                 "state_to": state_to,
+                 "consecutive_failures": consecutive_failures, "trips": trips}
+        if len(self.fault_log) < _FAULT_LOG_MAX:
+            self.fault_log.append(entry)
+        self.ledger.breaker(state_from, state_to,
+                            consecutive_failures=consecutive_failures,
+                            trips=trips)
 
     # ---- worker ----------------------------------------------------------
 
@@ -299,14 +518,23 @@ class EditEngine:
                 if p is not None:
                     prepared.append(p)
             for plan in plan_batches(prepared, max_batch=self.max_batch):
-                self._dispatch(plan)
+                try:
+                    self._dispatch(plan)
+                except Exception as e:  # noqa: BLE001 — the worker must outlive ANY batch
+                    for p in plan.items:
+                        self._fail(p.rid, f"dispatch failed unexpectedly: {e}",
+                                   time.perf_counter())
         self._done.set()
 
     def _collect(self):
         """One admit window: block for the first request, then keep
         draining compatible-or-not requests until ``max_batch`` are in
         hand or ``max_wait_s`` elapses (grouping happens after resolve —
-        an incompatible request simply lands in its own batch)."""
+        an incompatible request simply lands in its own batch). A closed
+        engine past its drain window stops collecting — close() fails
+        whatever is left."""
+        if self._closed and time.perf_counter() >= self._drain_until:
+            return None
         try:
             first = self._queue.get(timeout=0.2)
         except queue.Empty:
@@ -335,6 +563,18 @@ class EditEngine:
             rec.update(fields)
             return rec
 
+    def _deadline_expired(self, rid: str) -> bool:
+        with self._req_lock:
+            rec = self._requests.get(rid)
+            at = rec.get("deadline_at") if rec else None
+        return at is not None and time.perf_counter() > at
+
+    def _deadline_remaining(self, rid: str) -> Optional[float]:
+        with self._req_lock:
+            rec = self._requests.get(rid)
+            at = rec.get("deadline_at") if rec else None
+        return None if at is None else at - time.perf_counter()
+
     def _store_key(self, request: EditRequest, ctx) -> str:
         """Content-addressed inversion-product identity: the program-set
         fingerprint (checkpoint content + geometry + steps) x the clip
@@ -362,9 +602,14 @@ class EditEngine:
         )
 
     def _resolve(self, rid: str, request: EditRequest) -> Optional[_Prepared]:
-        """Admit one request: controller, prompt encodings, store lookup,
-        and on a miss the once-per-clip encode + capture-inversion."""
+        """Admit one request: controller, prompt encodings, store lookup
+        (resident → disk-rehydration → fresh), and on a full miss the
+        once-per-clip encode + capture-inversion."""
         t0 = time.perf_counter()
+        if self._deadline_expired(rid):
+            self._fail_status(rid, "deadline_exceeded",
+                              "deadline expired before resolve")
+            return None
         self._update(rid, status="resolving")
         try:
             ps = self.programs
@@ -384,8 +629,26 @@ class EditEngine:
             uncond = ps.encode_prompts([""])[0]
             key = self._store_key(request, ctx)
             products = self.store.get(key)
-            hit = products is not None
-            if not hit:
+            source = "memory" if products is not None else None
+            _, ik = jax.random.split(jax.random.key(request.seed))
+            if products is None:
+                # lazy crash-recovery rehydration: the persisted trajectory's
+                # leading entry IS the encoded source latents, so the warm
+                # inversion program rebuilds bit-identical capture products
+                # from it — no frame IO, no VAE encode, no cold compile,
+                # and no NEW inversion-from-frames on the books
+                traj_np = self.store.load_disk(key)
+                if traj_np is not None and traj_np.shape[0] == self.spec.steps + 1:
+                    anchor = jnp.asarray(traj_np[0])
+                    _, cached = ps.invert_capture(
+                        anchor, ps.encode_prompts([request.prompt]), ctx, ik
+                    )[:2]
+                    products = (cached, anchor)
+                    source = "disk"
+                    self._count("rehydrations")
+                    # resident again; already on disk — no re-persist
+                    self.store.put(key, products)
+            if products is None:
                 if request.frames is not None:
                     frames = np.asarray(request.frames)
                 else:
@@ -395,7 +658,6 @@ class EditEngine:
                         request.image_path, size=self.spec.width,
                         num_frames=self.spec.video_len,
                     )
-                _, ik = jax.random.split(jax.random.key(request.seed))
                 latents = ps.encode(
                     ps.frames_to_video(frames), jax.random.key(request.seed)
                 )
@@ -403,6 +665,8 @@ class EditEngine:
                     latents, ps.encode_prompts([request.prompt]), ctx, ik
                 )[:2]
                 products = (cached, latents)
+                source = "fresh"
+                self._count("fresh_inversions")
                 self.store.put(
                     key, products,
                     trajectory=(np.asarray(jax.device_get(traj))
@@ -426,7 +690,8 @@ class EditEngine:
             args = (cached, cond_all, uncond, ctx_edit, anchor)
             dt = time.perf_counter() - t0
             self.ledger.record_execute("serve_resolve", dt, dt)
-            self._update(rid, store_hit=hit, store_key=key, steps=steps,
+            self._update(rid, store_hit=source in ("memory", "disk"),
+                         store_source=source, store_key=key, steps=steps,
                          resolve_s=round(dt, 4))
             return _Prepared(
                 rid=rid, args=args, steps=steps,
@@ -439,41 +704,137 @@ class EditEngine:
             self._fail(rid, f"resolve failed: {e}", t0)
             return None
 
+    # ---- dispatch: watchdog + retry + breaker ----------------------------
+
+    def _device_dispatch(self, plan) -> List[Tuple[Any, Any]]:
+        """The batch's device math (singleton or stacked), blocked until
+        ready. The fault seam fires first — inside whatever watchdog
+        bounds this call, so an injected hang is bounded exactly like a
+        real wedge."""
+        if self.faults is not None:
+            self.faults.on_dispatch()
+        ps = self.programs
+        # compat keys carry the step count, so a plan is steps-homogeneous
+        steps = plan.items[0].steps
+        if plan.padded_size == 1:
+            videos, src_err = ps.edit_decode(*plan.items[0].args, steps=steps)
+            outs = [(videos, src_err)]
+        else:
+            stacked = stack_items(
+                [p.args for p in plan.items], plan.padded_size
+            )
+            videos_b, src_err_b = ps.edit_decode_batch(
+                stacked, plan.padded_size, dispatch=self.batch_dispatch,
+                steps=steps,
+            )
+            outs = unstack_outputs((videos_b, src_err_b), len(plan.items))
+        jax.block_until_ready([o[0] for o in outs])
+        return outs
+
+    def _watchdog_dispatch(self, plan, budget_s: Optional[float]):
+        """Bounded block-until-ready: run the device dispatch in a watchdog
+        thread and give it ``budget_s``; past the budget the stuck thread
+        is ABANDONED (daemon — a wedged device call cannot be cancelled,
+        only orphaned) and :class:`DeadlineExceeded` is raised so the
+        worker fails the batch and keeps serving. ``budget_s`` None runs
+        inline (no watchdog overhead when nothing bounds the dispatch)."""
+        if budget_s is None:
+            return self._device_dispatch(plan)
+        if budget_s <= 0:
+            raise DeadlineExceeded("dispatch budget already expired")
+        result: Dict[str, Any] = {}
+        done = threading.Event()
+
+        def runner():
+            try:
+                result["out"] = self._device_dispatch(plan)
+            except BaseException as e:  # noqa: BLE001 — carried to the worker
+                result["exc"] = e
+            done.set()
+
+        t = threading.Thread(target=runner, daemon=True,
+                             name="edit-engine-dispatch")
+        t.start()
+        if not done.wait(timeout=budget_s):
+            self._fault_event("watchdog_timeout",
+                              budget_s=round(budget_s, 3))
+            raise DeadlineExceeded(
+                f"dispatch exceeded its {budget_s:.3f}s budget "
+                "(watchdog abandoned the stuck dispatch)"
+            )
+        if "exc" in result:
+            raise result["exc"]
+        return result["out"]
+
     def _dispatch(self, plan) -> None:
-        """One device dispatch for a planned batch (singleton or stacked)."""
-        t0 = time.perf_counter()
-        for p in plan.items:
-            self._update(p.rid, status="running",
-                         batch_size=len(plan.items),
-                         padded_size=plan.padded_size)
-        try:
-            ps = self.programs
-            # compat keys carry the step count, so a plan is steps-homogeneous
-            steps = plan.items[0].steps
-            if plan.padded_size == 1:
-                videos, src_err = ps.edit_decode(*plan.items[0].args,
-                                                 steps=steps)
-                outs = [(videos, src_err)]
-            else:
-                stacked = stack_items(
-                    [p.args for p in plan.items], plan.padded_size
-                )
-                videos_b, src_err_b = ps.edit_decode_batch(
-                    stacked, plan.padded_size, dispatch=self.batch_dispatch,
-                    steps=steps,
-                )
-                outs = unstack_outputs(
-                    (videos_b, src_err_b), len(plan.items)
-                )
-            jax.block_until_ready([o[0] for o in outs])
+        """One planned batch through the resilience pipeline: deadline
+        expiry → bounded dispatch → deterministic retry on transient
+        failure → breaker accounting. A failed batch fails only its own
+        requests; the worker survives everything."""
+        attempt = 0
+        failed: set = set()
+        while True:
+            # expire items whose deadline passed (initial or burned by
+            # earlier attempts/backoff); the remaining ones still dispatch
+            # through the ORIGINAL plan (their lanes just go unread)
+            live = []
+            for p in plan.items:
+                if p.rid in failed:
+                    continue
+                if self._deadline_expired(p.rid):
+                    failed.add(p.rid)
+                    self._fail_status(p.rid, "deadline_exceeded",
+                                      "deadline expired before dispatch")
+                    continue
+                live.append(p)
+            if not live:
+                return
+            budgets = [self.dispatch_timeout_s]
+            budgets += [self._deadline_remaining(p.rid) for p in live]
+            budgets = [b for b in budgets if b is not None]
+            budget = min(budgets) if budgets else None
+            t0 = time.perf_counter()
+            for p in live:
+                self._update(p.rid, status="running",
+                             batch_size=len(plan.items),
+                             padded_size=plan.padded_size,
+                             dispatch_attempts=attempt + 1)
+            try:
+                outs = self._watchdog_dispatch(plan, budget)
+            except DeadlineExceeded as e:
+                # the budget is burned — never retried; the breaker counts
+                # it (a wedged device looks exactly like this)
+                self.breaker.record_failure()
+                for p in live:
+                    self._fail_status(p.rid, "deadline_exceeded", str(e))
+                return
+            except Exception as e:  # noqa: BLE001 — classified below
+                if (is_transient(e) and attempt < self.retry.max_retries
+                        and not self._closed):
+                    delay = self.retry.delay_s(attempt)
+                    self._count("retries")
+                    self._fault_event(
+                        "retry", attempt=attempt + 1,
+                        backoff_s=round(delay, 4),
+                        error=f"{type(e).__name__}: {e}",
+                    )
+                    time.sleep(delay)
+                    attempt += 1
+                    continue
+                self.breaker.record_failure()
+                for p in live:
+                    self._fail(p.rid, f"dispatch failed: {e}", t0)
+                return
+            # success: the breaker's half-open probe (or plain traffic)
+            self.breaker.record_success()
             dt = time.perf_counter() - t0
             self.ledger.record_execute("serve_dispatch", dt, dt)
             for p, (videos, src_err) in zip(plan.items, outs):
+                if p.rid in failed:
+                    continue
                 self._finish(p.rid, np.asarray(jax.device_get(videos)),
                              float(np.asarray(jax.device_get(src_err))), dt)
-        except Exception as e:  # noqa: BLE001
-            for p in plan.items:
-                self._fail(p.rid, f"dispatch failed: {e}", t0)
+            return
 
     def _finish(self, rid: str, videos: np.ndarray, src_err: float,
                 dispatch_s: float) -> None:
@@ -493,8 +854,8 @@ class EditEngine:
         self.ledger.record_execute("serve_request_e2e", total, total)
         compile_events = (len(self.ledger.compile_seconds)
                           - rec.get("compile_events_before", 0))
-        self._update(
-            rid, status="done",
+        self._terminalize(
+            rid, "done",
             dispatch_s=round(dispatch_s, 4), total_s=round(total, 4),
             src_err=src_err, compile_events=compile_events,
             inversion_gif=inversion_gif, edit_gif=edit_gif,
@@ -505,10 +866,30 @@ class EditEngine:
             store_hit=self.poll(rid).get("store_hit"),
         )
 
+    def _terminalize(self, rid: str, status: str, **fields) -> bool:
+        """Move a record to a terminal status exactly once (the in-flight
+        gauge decrements on the transition); False when already terminal."""
+        with self._req_lock:
+            rec = self._requests.get(rid)
+            if rec is None or rec["status"] in TERMINAL_STATUSES:
+                return False
+            rec["status"] = status
+            rec.update(fields)
+            self._inflight -= 1
+            return True
+
+    def _fail_status(self, rid: str, status: str, message: str,
+                     t0: Optional[float] = None) -> None:
+        started = t0 if t0 is not None else time.perf_counter()
+        if self._terminalize(
+            rid, status, error=message,
+            total_s=round(time.perf_counter() - started, 4),
+        ):
+            self.ledger.event("serve_request_error", id=rid, status=status,
+                              error=message)
+
     def _fail(self, rid: str, message: str, t0: float) -> None:
-        self._update(rid, status="error", error=message,
-                     total_s=round(time.perf_counter() - t0, 4))
-        self.ledger.event("serve_request_error", id=rid, error=message)
+        self._fail_status(rid, "error", message, t0)
 
     @staticmethod
     def _device_memory() -> List[Dict[str, Any]]:

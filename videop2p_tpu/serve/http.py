@@ -11,10 +11,20 @@ Endpoints (all JSON):
   * ``GET  /v1/edits/<id>/result?wait_s=N`` — block up to N s for a
     terminal record.
   * ``GET  /healthz``            — liveness + warm summary (200 always
-    once the engine exists; load balancers key on ``"ok"``).
+    once the engine exists; load balancers key on ``"ok"``). ``status``
+    is ``"degraded"`` while the circuit breaker is not closed, with the
+    breaker snapshot attached.
   * ``GET  /metrics``            — the live SLO record: per-program /
     per-phase latency percentiles from the ledger's reservoirs,
-    compile-vs-execute split, store hit rates, per-device HBM.
+    compile-vs-execute split, store hit rates, queue-depth / in-flight
+    gauges, the breaker snapshot, resilience counters, per-device HBM.
+
+Failure semantics (docs/SERVING.md): a full admit queue sheds the POST
+with **429** and the queue depth in the error body; an open circuit
+breaker (or a closed engine) fast-fails it with **503** plus a
+``Retry-After`` header carrying the breaker's remaining open window.
+Clients should back off accordingly (:class:`~videop2p_tpu.serve.client.
+EngineClient` does, deterministically).
 
 ``ThreadingHTTPServer`` handlers only enqueue and read — every device
 dispatch stays on the engine's single worker thread. Stdlib only; the
@@ -31,6 +41,7 @@ from typing import Any, Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 from videop2p_tpu.serve.engine import EditEngine, EditRequest
+from videop2p_tpu.serve.faults import EngineUnavailable, QueueFull
 
 __all__ = ["EditServer", "make_server"]
 
@@ -46,16 +57,21 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # quiet by default; the ledger records
         pass
 
-    def _send(self, code: int, payload: Dict[str, Any]) -> None:
+    def _send(self, code: int, payload: Dict[str, Any],
+              headers: Optional[Dict[str, str]] = None) -> None:
         body = json.dumps(payload, default=str).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
-    def _error(self, code: int, message: str) -> None:
-        self._send(code, {"error": message})
+    def _error(self, code: int, message: str, *,
+               headers: Optional[Dict[str, str]] = None,
+               **extra: Any) -> None:
+        self._send(code, {"error": message, **extra}, headers=headers)
 
     # ---- routes ----------------------------------------------------------
 
@@ -63,8 +79,14 @@ class _Handler(BaseHTTPRequestHandler):
         url = urlparse(self.path)
         try:
             if url.path == "/healthz":
+                breaker = self.engine.breaker.snapshot()
                 self._send(200, {
                     "ok": True,
+                    # load balancers key on "ok" (liveness); orchestrators
+                    # and dashboards key on "status" (serving health)
+                    "status": ("degraded" if breaker["state"] != "closed"
+                               else "ok"),
+                    "breaker": breaker,
                     "warm": self.engine.programs.warmed,
                     "spec_fingerprint": self.engine.spec.fingerprint(),
                 })
@@ -100,6 +122,22 @@ class _Handler(BaseHTTPRequestHandler):
                 body = json.loads(self.rfile.read(length) or b"{}")
                 request = EditRequest.from_dict(body)
                 rid = self.engine.submit(request)
+            except QueueFull as e:
+                # load shed: the bounded admit queue is full — the depth in
+                # the body lets clients reason about how overloaded we are
+                self._error(429, str(e), queue_depth=e.depth,
+                            max_queue=e.limit,
+                            headers={"Retry-After": "1"})
+                return
+            except EngineUnavailable as e:
+                headers = {}
+                if e.retry_after_s is not None:
+                    headers["Retry-After"] = str(
+                        max(int(e.retry_after_s + 0.999), 1)
+                    )
+                self._error(503, str(e), headers=headers,
+                            retry_after_s=e.retry_after_s)
+                return
             except (ValueError, TypeError) as e:
                 self._error(400, str(e))
                 return

@@ -19,7 +19,14 @@ stale hits are impossible by construction):
     ``utils/inv_cache`` under a shared root so CLI runs, sweeps
     (``cli/sweep.py --inv_store``) and engine restarts can reuse it. The
     capture trees are NOT persisted (they are an HBM-scale artifact and
-    cheap to rebuild relative to their size on disk).
+    cheap to rebuild relative to their size on disk). :meth:`InversionStore.
+    load_disk` is the crash-recovery read path: a restarted engine
+    rehydrates the device LRU lazily from here (the engine rebuilds the
+    capture via its warm inversion program from ``trajectory[0]`` — no
+    frame IO, no VAE encode, no cold compile). The loaded trajectory is
+    VALIDATED (finite, non-empty) before use and the fault-injection seam
+    (:class:`~videop2p_tpu.serve.faults.FaultPlan` ``corrupt:PAT``) can
+    deterministically corrupt entries to prove the detection path.
 
 Stdlib+numpy+jax only — the import-guard test walks this package like
 ``obs/``.
@@ -69,17 +76,23 @@ class InversionStore:
     engine worker mutates entries.
     """
 
-    def __init__(self, byte_budget: int, *, persist_dir: Optional[str] = None):
+    def __init__(self, byte_budget: int, *, persist_dir: Optional[str] = None,
+                 faults: Optional[Any] = None):
         if byte_budget <= 0:
             raise ValueError(f"byte_budget must be positive, got {byte_budget}")
         self.byte_budget = int(byte_budget)
         self.persist_dir = persist_dir
+        # fault-injection seam (serve/faults.py FaultPlan): lets the chaos
+        # tests deterministically corrupt disk loads; None in production
+        self.faults = faults
         self._entries: "OrderedDict[str, StoreEntry]" = OrderedDict()
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
         self.evictions = 0
         self.rejected_oversize = 0
+        self.disk_hits = 0
+        self.disk_corrupt = 0
 
     # ---- resident layer --------------------------------------------------
 
@@ -123,6 +136,41 @@ class InversionStore:
     def _bytes_locked(self) -> int:
         return sum(e.nbytes for e in self._entries.values())
 
+    # ---- crash-recovery read path ----------------------------------------
+
+    def load_disk(self, key: str) -> Optional[np.ndarray]:
+        """The lazy-rehydration read: the persisted trajectory for ``key``
+        (inversion-walk order, ``trajectory[0]`` = the encoded source
+        latents), or None when absent OR invalid. Validation is load-time:
+        a corrupted entry (non-finite values, empty/odd shape — injected
+        by the fault seam or a real torn write) is detected HERE and
+        reported as a miss, so the engine falls back to a fresh inversion
+        instead of ever serving garbage; ``disk_corrupt`` counts it."""
+        if not self.persist_dir:
+            return None
+        try:
+            loaded = load_persisted_inversion(self.persist_dir, key)
+        except Exception:  # noqa: BLE001 — a broken disk layer is a miss, not a crash
+            return None
+        if loaded is None:
+            return None
+        traj = loaded[0]
+        if traj is not None and self.faults is not None and \
+                self.faults.corrupts(key):
+            # deterministic injected corruption: poison the leading entry
+            # (the anchor the rebuild would start from) — exactly what the
+            # validation below must catch
+            traj = np.array(traj, copy=True)
+            traj[0] = np.nan
+        if (traj is None or getattr(traj, "size", 0) == 0
+                or traj.ndim < 2 or not np.all(np.isfinite(traj))):
+            with self._lock:
+                self.disk_corrupt += 1
+            return None
+        with self._lock:
+            self.disk_hits += 1
+        return np.asarray(traj)
+
     def __contains__(self, key: str) -> bool:
         with self._lock:
             return key in self._entries
@@ -149,6 +197,8 @@ class InversionStore:
             "misses": self.misses,
             "evictions": self.evictions,
             "rejected_oversize": self.rejected_oversize,
+            "disk_hits": self.disk_hits,
+            "disk_corrupt": self.disk_corrupt,
             "hit_rate": round(self.hits / total, 4) if total else None,
         }
 

@@ -36,12 +36,24 @@ def save_checkpoint(output_dir: str, state: Any, step: int) -> str:
 
 
 def restore_checkpoint(path: str, target: Any) -> Any:
-    """Restore a pytree with the structure/sharding of ``target``."""
+    """Restore a pytree with the structure/sharding of ``target``.
+
+    Leaves are COPIED into fresh jax-owned device buffers: orbax hands
+    back host arrays whose storage it (or tensorstore) may still own, and
+    on CPU jax's zero-copy ingestion would otherwise let a later DONATED
+    call (run_tuning's ``train_steps`` carry) alias memory jax does not
+    own — a use-after-free that shows up as garbage weights in the
+    resumed run's next checkpoint (caught by the ISSUE-9 resume test)."""
+    import jax.numpy as jnp
+
     abstract = jax.tree.map(
         lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype) if hasattr(x, "shape") else x,
         target,
     )
-    return _checkpointer().restore(os.path.abspath(path), abstract)
+    restored = _checkpointer().restore(os.path.abspath(path), abstract)
+    return jax.tree.map(
+        lambda x: jnp.array(x) if hasattr(x, "shape") else x, restored
+    )
 
 
 def latest_checkpoint(output_dir: str) -> Optional[str]:
