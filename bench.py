@@ -684,6 +684,120 @@ def record_null_text_flops(rec, *, tiny=False, timeout_s=None,
         rec.record(k, v)
 
 
+# the measured-scale-out evidence grid (ISSUE 10): ring comm+flop records
+# per frame count over this many sequence shards, plus the Megatron tp
+# pairing — static XLA counts, backend-independent, captured every round
+FRAME_SCALING_COUNTS = (8, 32, 64)
+FRAME_SCALING_SHARDS = 8
+# schema-stable per-record field set (tests/test_bench_guard.py pins it)
+FRAME_SCALING_FIELDS = (
+    "frames", "shards", "variant", "collective_permute_count",
+    "collective_permute_bytes", "bytes_per_permute", "flops",
+    "permute_count_vs_serial", "permute_bytes_vs_serial",
+)
+TP_PAIRING_FIELDS = (
+    "shards", "all_reduce_bytes", "reduce_scatter_bytes",
+    "bytes_reduction", "flops",
+)
+
+
+def frame_scaling_records(analyses, *, shards=FRAME_SCALING_SHARDS):
+    """Per-frame-count ring comm/flop records from the
+    ``ring_unit_<variant>_f<F>`` unit analyses
+    (tools/cpu_cost_capture.py): one record per (frames, variant) with the
+    TRUE static collective-permute counts (the rotation loop is unrolled —
+    parallel/ring.py) and the vs-serial ratios that state the engineered
+    win machine-readably (overlap: (n−1)/n counts AND bytes; bidir: same
+    bytes at half the per-permute payload). Pure + CPU-tested so the
+    record shape cannot drift; every record carries exactly
+    ``FRAME_SCALING_FIELDS``."""
+    by_frames = {}
+    for name, a in (analyses or {}).items():
+        if not isinstance(a, dict) or not name.startswith("ring_unit_"):
+            continue
+        variant, _, fpart = name[len("ring_unit_"):].rpartition("_f")
+        if not variant or not fpart.isdigit():
+            continue
+        by_frames.setdefault(int(fpart), {})[variant] = a
+    records = []
+    for frames in sorted(by_frames):
+        variants = by_frames[frames]
+        serial = variants.get("serial") or {}
+        s_count = int(serial.get("collective_permute_count") or 0)
+        s_bytes = int(serial.get("collective_permute_bytes") or 0)
+        for variant in ("serial", "overlap", "bidir"):
+            a = variants.get(variant)
+            if a is None:
+                continue
+            count = int(a.get("collective_permute_count") or 0)
+            nbytes = int(a.get("collective_permute_bytes") or 0)
+            records.append({
+                "frames": frames,
+                "shards": int(a.get("shards") or shards),
+                "variant": variant,
+                "collective_permute_count": count,
+                "collective_permute_bytes": nbytes,
+                "bytes_per_permute": (nbytes // count) if count else None,
+                "flops": a.get("flops"),
+                "permute_count_vs_serial": (
+                    round(count / s_count, 3) if s_count else None
+                ),
+                "permute_bytes_vs_serial": (
+                    round(nbytes / s_bytes, 3) if s_bytes else None
+                ),
+            })
+    return records
+
+
+def tp_pairing_record(analyses, *, shards=FRAME_SCALING_SHARDS):
+    """The Megatron pairing evidence from the ``tp_unit_{gspmd,scatter}``
+    unit analyses: declarative all-reduce result bytes vs the explicit
+    ``psum_scatter`` seam's reduce-scatter bytes (= all-reduce ÷ tp).
+    None when either unit is missing; carries exactly
+    ``TP_PAIRING_FIELDS``."""
+    g = (analyses or {}).get("tp_unit_gspmd")
+    s = (analyses or {}).get("tp_unit_scatter")
+    if not isinstance(g, dict) or not isinstance(s, dict):
+        return None
+    ar = int(g.get("all_reduce_bytes") or 0)
+    rs = int(s.get("reduce_scatter_bytes") or 0)
+    return {
+        "shards": int(g.get("shards") or shards),
+        "all_reduce_bytes": ar,
+        "reduce_scatter_bytes": rs,
+        "bytes_reduction": round(ar / rs, 2) if rs else None,
+        "flops": g.get("flops"),
+    }
+
+
+def record_frame_scaling(rec, *, timeout_s=None,
+                         frame_counts=FRAME_SCALING_COUNTS,
+                         shards=FRAME_SCALING_SHARDS) -> None:
+    """Capture the ring/tp unit analyses (CPU subprocess — static comm
+    counts and flops are backend-independent) and persist the
+    per-frame-count scale-out records. Best-effort: a failed capture
+    records nothing rather than killing the round."""
+    timeout_s = timeout_s if timeout_s is not None else float(os.environ.get(
+        "VIDEOP2P_BENCH_CPU_ANALYSIS_TIMEOUT", "900"))
+    programs = [f"ring_unit_{v}_f{f}" for f in frame_counts
+                for v in ("serial", "overlap", "bidir")]
+    programs += ["tp_unit_gspmd", "tp_unit_scatter"]
+    analyses = collect_cpu_analysis(
+        BENCH_FRAMES, BENCH_STEPS, timeout_s=timeout_s, programs=programs,
+    )
+    records = frame_scaling_records(analyses, shards=shards)
+    if not records:
+        print("[bench] frame-scaling unit capture incomplete "
+              f"(have {sorted(analyses)}) — skipping the record",
+              file=sys.stderr, flush=True)
+        return
+    rec.record("frame_scaling", records)
+    rec.record("frame_scaling_backend", "cpu-static")
+    tp = tp_pairing_record(analyses, shards=shards)
+    if tp is not None:
+        rec.record("tp_pairing", tp)
+
+
 def build_fast_edit_working_point(*, num_frames: int = 8, num_steps: int = 50,
                                   frame_attention: str = "auto",
                                   group_norm: str = "auto",
@@ -1062,6 +1176,9 @@ def record_cpu_only_evidence(repo_dir=None) -> None:
     # tiny-scale CPU step frontier (executed — quality metrics per step
     # count, wall-clock disclosed as CPU-tiny, never a TPU claim)
     record_null_text_flops(rec, timeout_s=timeout_s)
+    # the measured-scale-out evidence (ISSUE 10): per-frame-count ring
+    # comm/flop records + the Megatron tp pairing, static and CPU-cheap
+    record_frame_scaling(rec, timeout_s=timeout_s)
     frontier = collect_step_frontier(timeout_s=timeout_s, tiny=True)
     if frontier:
         rec.record("latency_quality_frontier", frontier)
@@ -1774,6 +1891,10 @@ def main() -> None:
             # analyses (CPU subprocess — flop counts are backend-blind);
             # the ISSUE-8 ≥3× acceptance reads these reduction ratios
             record_null_text_flops(rec)
+            # per-frame-count ring comm/flop records + the Megatron tp
+            # pairing (ISSUE 10) — static counts, recorded on-TPU rounds
+            # too so the scale-out evidence never skips a round
+            record_frame_scaling(rec)
             del nmix_stats, r_nmix
 
             # Stage-1 tuning step on a cleared chip (its grad program +

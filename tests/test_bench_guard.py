@@ -838,3 +838,116 @@ def test_cpu_cost_capture_tool_end_to_end_tiny(bench, tmp_path):
     events = [json.loads(l) for l in open(ledger) if l.strip()]
     pa = {e["program"] for e in events if e["event"] == "program_analysis"}
     assert pa == set(out)
+
+
+def test_frame_scaling_record_schema(bench):
+    """ISSUE 10: the per-frame-count scale-out records are schema-pinned —
+    every ring record carries exactly FRAME_SCALING_FIELDS with the
+    vs-serial ratios, the tp pairing exactly TP_PAIRING_FIELDS, and
+    degenerate inputs yield empty/None instead of raising."""
+    analyses = {
+        "ring_unit_serial_f64": {"collective_permute_count": 16,
+                                 "collective_permute_bytes": 8192,
+                                 "flops": 100, "shards": 8},
+        "ring_unit_overlap_f64": {"collective_permute_count": 14,
+                                  "collective_permute_bytes": 7168,
+                                  "flops": 90, "shards": 8},
+        "ring_unit_bidir_f64": {"collective_permute_count": 28,
+                                "collective_permute_bytes": 7168,
+                                "flops": 95, "shards": 8},
+        "ring_unit_overlap_f8": {"collective_permute_count": 14,
+                                 "collective_permute_bytes": 896,
+                                 "flops": 9, "shards": 8},
+        "tp_unit_gspmd": {"all_reduce_bytes": 32768, "flops": 7, "shards": 8},
+        "tp_unit_scatter": {"reduce_scatter_bytes": 4096, "flops": 7,
+                            "shards": 8},
+        "not_a_ring_unit": {"flops": 1},
+    }
+    records = bench.frame_scaling_records(analyses)
+    assert [r["frames"] for r in records] == [8, 64, 64, 64]
+    for r in records:
+        assert set(r) == set(bench.FRAME_SCALING_FIELDS), r
+    by = {(r["frames"], r["variant"]): r for r in records}
+    assert by[(64, "overlap")]["permute_count_vs_serial"] == round(14 / 16, 3)
+    assert by[(64, "overlap")]["permute_bytes_vs_serial"] == 0.875
+    assert by[(64, "bidir")]["bytes_per_permute"] == 7168 // 28
+    # the 8-frame group has no serial record → ratios None, shape stable
+    assert by[(8, "overlap")]["permute_count_vs_serial"] is None
+
+    tp = bench.tp_pairing_record(analyses)
+    assert set(tp) == set(bench.TP_PAIRING_FIELDS)
+    assert tp["bytes_reduction"] == 8.0
+    assert bench.frame_scaling_records({}) == []
+    assert bench.tp_pairing_record({}) is None
+    assert bench.tp_pairing_record({"tp_unit_gspmd": {"all_reduce_bytes": 1,
+                                                      "shards": 8}}) is None
+
+
+@pytest.mark.slow
+def test_dryrun_longvideo_obs_acceptance(graft, tmp_path):
+    """The ISSUE 10 acceptance criterion end to end on the in-process
+    8-device CPU mesh: the 64-frame dryrun section completes its float8
+    sharded cached edit with src_err == 0.0, lands per-frame-count
+    frame_scaling events and the ring/tp comm evidence in the ledger, and
+    the ring before/after pair gates through tools/obs_diff.py — exit 0 in
+    the engineered direction (collective count/bytes DROP), exit 0 on
+    self-compare, exit 1 on an injected collective-bytes bump."""
+    from videop2p_tpu.obs.ledger import RunLedger
+
+    ledger_path = str(tmp_path / "longvideo_ledger.jsonl")
+    led = RunLedger(ledger_path, mesh="1,8,1",
+                    meta={"cli": "longvideo_acceptance"}).activate()
+    try:
+        res = graft._dryrun_longvideo_impl(8, led)
+    finally:
+        led.close()
+    assert res["src_err_64f"] == 0.0
+    assert res["ring"]["overlap"]["collective_permute_count"] == 14
+    assert res["ring"]["serial"]["collective_permute_count"] == 16
+
+    events = [json.loads(l) for l in open(ledger_path) if l.strip()]
+    fs = [e for e in events if e["event"] == "frame_scaling"]
+    assert {e["frames"] for e in fs} >= {8, 32, 64}
+    edit = [e for e in fs if e["variant"] == "edit"]
+    assert edit and edit[0]["src_err"] == 0.0
+    assert edit[0]["temporal_maps_dtype"] == "float8_e4m3fn"
+    comm = [e for e in events if e["event"] == "comm_analysis"]
+    assert any(e["program"] == "sharded_edit_64f" for e in comm)
+    assert any(e["program"] == "tp_out_scatter" for e in comm)
+
+    obs_diff = _load_module("obs_diff_under_longvideo_test", "tools/obs_diff.py")
+    assert obs_diff.main(
+        ["obs_diff.py", res["ring_before"], res["ring_after"]]
+    ) == 0
+    assert obs_diff.main(["obs_diff.py", ledger_path, ledger_path]) == 0
+    perturbed = str(tmp_path / "perturbed.jsonl")
+    with open(perturbed, "w") as f:
+        for e in events:
+            if e["event"] == "comm_analysis":
+                e = dict(e, collective_bytes=int(e["collective_bytes"] * 1.2))
+            f.write(json.dumps(e) + "\n")
+    assert obs_diff.main(["obs_diff.py", ledger_path, perturbed]) == 1
+
+
+@pytest.mark.slow
+def test_cpu_cost_capture_ring_tp_units(bench):
+    """The real subprocess path for the distributed unit programs: one
+    JSON record per ring variant × frame count (true unrolled counts,
+    frames overriding the global flag) plus the tp pairing units."""
+    out = bench.collect_cpu_analysis(
+        2, 2, tiny=True, timeout_s=560.0,
+        programs=("ring_unit_serial_f64", "ring_unit_overlap_f64",
+                  "ring_unit_bidir_f64", "tp_unit_gspmd", "tp_unit_scatter"),
+    )
+    assert set(out) == {"ring_unit_serial_f64", "ring_unit_overlap_f64",
+                        "ring_unit_bidir_f64", "tp_unit_gspmd",
+                        "tp_unit_scatter"}
+    assert out["ring_unit_serial_f64"]["collective_permute_count"] == 16
+    assert out["ring_unit_overlap_f64"]["collective_permute_count"] == 14
+    assert out["ring_unit_bidir_f64"]["collective_permute_count"] == 28
+    assert all(out[p]["frames"] == 64 for p in out if p.startswith("ring"))
+    assert (out["tp_unit_scatter"]["reduce_scatter_bytes"]
+            == out["tp_unit_gspmd"]["all_reduce_bytes"] // 8)
+    records = bench.frame_scaling_records(out)
+    assert {r["variant"] for r in records} == {"serial", "overlap", "bidir"}
+    assert bench.tp_pairing_record(out)["bytes_reduction"] == 8.0

@@ -286,6 +286,20 @@ def test_programset_spec_fingerprint_content_addressed():
     assert a.fingerprint() != ProgramSpec(**{**_SPEC_KW, "steps": 4}).fingerprint()
     # the tiny-width rule resolves before fingerprinting (512 -> 16)
     assert ProgramSpec(**{**_SPEC_KW, "width": 512}).fingerprint() == a.fingerprint()
+    # the sharded-schedule knobs are content-addressed (ISSUE 10): a ring
+    # or tp-collective schedule change builds DIFFERENT compiled programs,
+    # so sharded specs must never collide with single-chip ones — nor with
+    # each other across schedules
+    variants = {
+        ProgramSpec(**kw).fingerprint()
+        for kw in (
+            _SPEC_KW,
+            {**_SPEC_KW, "mesh": "1,4,2"},
+            {**_SPEC_KW, "mesh": "1,4,2", "ring_variant": "bidir"},
+            {**_SPEC_KW, "mesh": "1,4,2", "tp_collectives": "psum_scatter"},
+        )
+    }
+    assert len(variants) == 4
 
 
 def test_batched_scan_dispatch_bit_exact_vs_singleton(programs):

@@ -294,3 +294,26 @@ def test_ledger_summary_renders_timing_and_trace_tables(tmp_path):
     assert "trace analysis" in text and "edit_window" in text
     assert "0.75" in text
     assert "fusion=1.000s" in text
+
+
+def test_obs_diff_overlap_fraction_decrease_teeth(tmp_path, capsys):
+    """ISSUE 10: overlap is now an ENGINEERED property, so its regression
+    direction has CLI teeth — two ledgers identical except for a dropped
+    compute/collective overlap_fraction must exit 1 through obs_diff with
+    the decrease-direction trace verdict (and the improved direction,
+    overlap RISING, exits 0)."""
+    mod = _load_tool("obs_diff")
+    res = _base_reservoir()
+    a = str(tmp_path / "a.jsonl")
+    b = str(tmp_path / "b.jsonl")
+    trace = {"name": "cached_pair", "device_total_s": 1.0,
+             "collective_s": 0.4, "overlap_fraction": 0.8, "idle_s": 0.1}
+    _timing_ledger(a, "a", res, trace_fields=trace)
+    _timing_ledger(b, "b", res, trace_fields=dict(trace,
+                                                  overlap_fraction=0.4))
+    assert mod.main(["obs_diff.py", "--json", a, b]) == 1
+    verdict = json.loads(capsys.readouterr().out)
+    rules = {v["rule"] for v in verdict["regressions"]}
+    assert rules == {"trace:overlap_fraction-10%"}
+    # the engineered direction — overlap GROWS — is never a regression
+    assert mod.main(["obs_diff.py", b, a]) == 0

@@ -2,7 +2,19 @@
 
 Usage:  python tools/cpu_cost_capture.py [--frames 8] [--steps 50] [--tiny]
             [--programs invert_captured,edit_cached,e2e_cached]
-            [--ledger PATH]
+            [--frame_counts 8,32,64] [--shards 8] [--ledger PATH]
+
+Besides the UNet pipeline programs, the tool builds the DISTRIBUTED unit
+programs (ISSUE 10): ``ring_unit_{serial,overlap,bidir}_f<F>`` — the
+standalone ring-attention pass at ``F`` frames over ``--shards`` virtual
+devices, whose unrolled rotation loop makes the static collective-permute
+counts TRUE per-pass counts (serial 2n / overlap 2(n−1) / bidir 4(n−1) at
+half payload) — and ``tp_unit_{gspmd,scatter}`` — the Megatron
+row-parallel output projection, declarative all-reduce vs the explicit
+``psum_scatter`` seam. Their records merge the comm accounting
+(``obs/comm.py`` collective counts/bytes) into the cost analysis, so
+per-frame-count comm+flop evidence lands in ``bench_details.json`` even
+on ``backend_unavailable`` rounds (``bench.record_frame_scaling``).
 
 Builds the bench's headline programs (the captured inversion, the cached
 2-stream edit, and the fused e2e — the same pipeline calls
@@ -185,6 +197,52 @@ def build_abstract_programs(frames: int, steps: int, tiny: bool):
     }
 
 
+def unit_program_records(wanted: List[str], shards: int):
+    """Build + analyze the requested ring/tp unit programs (names
+    ``ring_unit_<variant>_f<F>`` / ``tp_unit_<gspmd|scatter>``) on a
+    ``shards``-wide virtual mesh. Returns ``{name: record}`` with the
+    comm accounting merged in; unknown unit names raise ValueError."""
+    from videop2p_tpu.parallel import make_mesh
+
+    import __graft_entry__ as graft
+
+    ring_mesh = tp_mesh = None
+    ring_cache: dict = {}
+    tp_cache: dict = {}
+    out = {}
+    for name in wanted:
+        if name.startswith("ring_unit_"):
+            rest = name[len("ring_unit_"):]
+            variant, _, fpart = rest.rpartition("_f")
+            if not variant or not fpart.isdigit():
+                raise ValueError(f"bad ring unit name {name!r} "
+                                 "(want ring_unit_<variant>_f<frames>)")
+            frames = int(fpart)
+            if frames % shards:
+                raise ValueError(f"{name!r}: {shards} shards cannot divide "
+                                 f"{frames} frames")
+            if ring_mesh is None:
+                ring_mesh = make_mesh((1, shards, 1),
+                                      devices=jax.devices()[:shards])
+            if frames not in ring_cache:
+                ring_cache[frames] = graft._ring_unit_records(ring_mesh, frames)
+            if variant not in ring_cache[frames]:
+                raise ValueError(f"unknown ring variant in {name!r}")
+            out[name] = dict(ring_cache[frames][variant], shards=shards)
+        elif name.startswith("tp_unit_"):
+            variant = name[len("tp_unit_"):]
+            if tp_mesh is None:
+                tp_mesh = make_mesh((1, 1, shards),
+                                    devices=jax.devices()[:shards])
+            if not tp_cache:
+                tp_cache = graft._tp_unit_records(tp_mesh)
+            if variant not in tp_cache:
+                raise ValueError(f"unknown tp unit {name!r} "
+                                 f"(have {sorted(tp_cache)})")
+            out[name] = dict(tp_cache[variant], shards=shards)
+    return out
+
+
 def main(argv: List[str]) -> int:
     parser = argparse.ArgumentParser(prog="cpu_cost_capture.py",
                                      description=__doc__)
@@ -194,6 +252,9 @@ def main(argv: List[str]) -> int:
                         help="tiny UNet config (fast; used by tests)")
     parser.add_argument("--programs", type=str,
                         default="invert_captured,edit_cached,e2e_cached")
+    parser.add_argument("--shards", type=int, default=8,
+                        help="virtual device count for the ring/tp unit "
+                             "programs")
     parser.add_argument("--ledger", type=str, default=None,
                         help="also append program_analysis events to this "
                              "run-ledger JSONL")
@@ -201,12 +262,32 @@ def main(argv: List[str]) -> int:
 
     from videop2p_tpu.obs.introspect import analyze_jitted
 
-    programs = build_abstract_programs(args.frames, args.steps, args.tiny)
     wanted = [p.strip() for p in args.programs.split(",") if p.strip()]
-    unknown = [p for p in wanted if p not in programs]
+    unit_wanted = [p for p in wanted
+                   if p.startswith(("ring_unit_", "tp_unit_"))]
+    if unit_wanted:
+        # the unit programs shard over a virtual CPU mesh; the flag only
+        # takes effect because no backend has initialized yet (this tool
+        # always runs as a fresh subprocess)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{args.shards}"
+            ).strip()
+
+    pipeline_wanted = [p for p in wanted if p not in unit_wanted]
+    programs = build_abstract_programs(args.frames, args.steps, args.tiny)
+    unknown = [p for p in pipeline_wanted if p not in programs]
     if unknown:
         print(f"cpu_cost_capture: unknown programs {unknown} "
-              f"(have {sorted(programs)})", file=sys.stderr)
+              f"(have {sorted(programs)} + ring_unit_<variant>_f<F> + "
+              f"tp_unit_<gspmd|scatter>)", file=sys.stderr)
+        return 2
+    try:
+        unit_records = unit_program_records(unit_wanted, args.shards)
+    except ValueError as e:
+        print(f"cpu_cost_capture: {e}", file=sys.stderr)
         return 2
 
     ledger = None
@@ -218,8 +299,11 @@ def main(argv: List[str]) -> int:
                                               "steps": args.steps}).activate()
     rc = 0
     for name in wanted:
-        jitted, abstract_args = programs[name]
-        rec = analyze_jitted(jitted, *abstract_args)
+        if name in unit_records:
+            rec = unit_records[name]
+        else:
+            jitted, abstract_args = programs[name]
+            rec = analyze_jitted(jitted, *abstract_args)
         if rec is None:
             print(f"cpu_cost_capture: analysis failed for {name}",
                   file=sys.stderr)

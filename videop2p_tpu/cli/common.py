@@ -108,12 +108,25 @@ def enable_compile_cache(env_var: str = "VIDEOP2P_COMPILE_CACHE") -> None:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 
-def setup_mesh(bundle: "ModelBundle", mesh_spec: str, video_len: int):
+def setup_mesh(bundle: "ModelBundle", mesh_spec: str, video_len: int,
+               ring_variant: str = None, tp_collectives: str = None):
     """Parse a ``dp,sp,tp`` mesh spec and prepare the bundle for it: build
     the device mesh, wire ring attention into the UNet's uncontrolled
     temporal sites when frames are sharded, and shard the UNet params.
-    Returns the mesh. Both CLIs share this; single-clip flows need dp=1."""
+    Returns the mesh. Both CLIs share this; single-clip flows need dp=1.
+
+    ``ring_variant`` picks the ring rotation schedule (``overlap`` — the
+    double-buffered default — or ``bidir``/``serial``; None reads
+    ``VIDEOP2P_RING_VARIANT``). ``tp_collectives="psum_scatter"`` wires the
+    explicit Megatron reduce-scatter output seam on tensor-parallel meshes
+    (None reads ``VIDEOP2P_TP_COLLECTIVES``, default ``gspmd`` —
+    declarative)."""
+    import os as _os
+
     from videop2p_tpu.parallel import (
+        RING_VARIANTS,
+        TP_COLLECTIVES,
+        make_megatron_out_dot,
         make_mesh,
         make_ring_temporal_fn,
         make_sharded_frame_attention_fn,
@@ -121,6 +134,23 @@ def setup_mesh(bundle: "ModelBundle", mesh_spec: str, video_len: int):
         param_shardings,
     )
 
+    if ring_variant is None:
+        from videop2p_tpu.parallel import default_ring_variant
+
+        ring_variant = default_ring_variant()
+    if ring_variant not in RING_VARIANTS:
+        raise ValueError(
+            f"ring_variant must be one of {RING_VARIANTS}, got {ring_variant!r}"
+        )
+    if tp_collectives is None:
+        tp_collectives = _os.environ.get(
+            "VIDEOP2P_TP_COLLECTIVES", "gspmd"
+        ).strip().lower()
+    if tp_collectives not in TP_COLLECTIVES:
+        raise ValueError(
+            f"tp_collectives must be one of {TP_COLLECTIVES}, "
+            f"got {tp_collectives!r}"
+        )
     shape = tuple(int(t) for t in str(mesh_spec).split(","))
     if len(shape) != 3:
         raise ValueError(f"--mesh must be dp,sp,tp — got {mesh_spec!r}")
@@ -153,8 +183,16 @@ def setup_mesh(bundle: "ModelBundle", mesh_spec: str, video_len: int):
         # fused Pallas kernel on the sharded frame-attention sites via
         # shard_map (pjit alone cannot partition a Pallas custom call)
         bundle.unet = bundle.unet.clone(
-            temporal_attention_fn=make_ring_temporal_fn(device_mesh),
+            temporal_attention_fn=make_ring_temporal_fn(
+                device_mesh, variant=ring_variant
+            ),
             frame_attention_fn=make_sharded_frame_attention_fn(device_mesh),
+        )
+    if tp > 1 and tp_collectives == "psum_scatter":
+        # explicit Megatron row-parallel outputs: reduce-scatter over the
+        # token axis instead of the declarative all-reduce
+        bundle.unet = bundle.unet.clone(
+            row_parallel_dot=make_megatron_out_dot(device_mesh)
         )
     bundle.unet_params = jax.device_put(
         bundle.unet_params,

@@ -33,6 +33,17 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--mesh", type=str, default=None,
                     help="dp,sp,tp — sp/tp shard the model; dp>1 is the "
                          "serving data axis batched dispatches shard over")
+    ap.add_argument("--ring_variant", type=str, default="overlap",
+                    choices=["overlap", "bidir", "serial"],
+                    help="ring-attention rotation schedule on sp>1 meshes "
+                         "(parallel/ring.py): overlap = double-buffered "
+                         "n-1 rotations, bidir = split halves on both ICI "
+                         "directions; enters the spec fingerprint")
+    ap.add_argument("--tp_collectives", type=str, default="gspmd",
+                    choices=["gspmd", "psum_scatter"],
+                    help="row-parallel output reduction on tp>1 meshes: "
+                         "declarative all-reduce vs the explicit Megatron "
+                         "reduce-scatter seam; enters the spec fingerprint")
     ap.add_argument("--host", type=str, default="127.0.0.1")
     ap.add_argument("--port", type=int, default=8000)
     ap.add_argument("--out_dir", type=str, default="serve_out",
@@ -113,6 +124,7 @@ def main(argv=None) -> int:
         video_len=args.video_len, steps=args.steps,
         guidance_scale=args.guidance_scale, tiny=args.tiny,
         mixed_precision=args.mixed_precision, seed=args.seed, mesh=args.mesh,
+        ring_variant=args.ring_variant, tp_collectives=args.tp_collectives,
     )
     faults = FaultPlan.parse(args.faults) if args.faults else None
     if faults is not None:

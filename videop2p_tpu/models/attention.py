@@ -134,6 +134,11 @@ class FrameAttention(nn.Module):
     dim_head: int
     dtype: Dtype = jnp.float32
     attention_fn: Optional[Callable[[jax.Array, jax.Array, jax.Array], jax.Array]] = None
+    # explicit Megatron row-parallel output projection: a ``dot_general``
+    # replacement for the to_out matmul (parallel.make_megatron_out_dot —
+    # psum_scatter over the token axis instead of the all-reduce GSPMD
+    # inserts when the kernel's rows shard over ``tensor``)
+    row_parallel_dot: Optional[Callable] = None
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -157,7 +162,9 @@ class FrameAttention(nn.Module):
             out = jnp.einsum("bfhqk,bhkd->bfhqd", probs, v)
 
         out = out.transpose(0, 1, 3, 2, 4).reshape(b, f, n, inner)
-        return nn.Dense(inner, dtype=self.dtype, name="to_out")(out)
+        rp = ({"dot_general": self.row_parallel_dot}
+              if self.row_parallel_dot is not None else {})
+        return nn.Dense(inner, dtype=self.dtype, name="to_out", **rp)(out)
 
 
 class ControlledAttention(nn.Module):
@@ -182,6 +189,10 @@ class ControlledAttention(nn.Module):
     # passes need materialized probabilities (SURVEY §7 hard-part 2), so a
     # non-None ``control`` always takes the dense path.
     attention_fn: Optional[Callable[[jax.Array, jax.Array, jax.Array], jax.Array]] = None
+    # explicit Megatron row-parallel to_out (see FrameAttention); the block
+    # threads it to the CROSS site only — the temporal site's token axis is
+    # the frame axis, which belongs to the ``frames`` mesh axis
+    row_parallel_dot: Optional[Callable] = None
 
     @nn.compact
     def __call__(
@@ -202,9 +213,8 @@ class ControlledAttention(nn.Module):
         if self.attention_fn is not None and control is None:
             out = self.attention_fn(q, k, v)
             out = _merge_heads(out)
-            kernel_init = nn.initializers.zeros if self.zero_init_out else None
-            kwargs = {"kernel_init": kernel_init} if kernel_init is not None else {}
-            return nn.Dense(inner, dtype=self.dtype, name="to_out", **kwargs)(out)
+            return nn.Dense(inner, dtype=self.dtype, name="to_out",
+                            **self._out_kwargs())(out)
 
         sim = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (self.dim_head ** -0.5)
         probs = _stable_softmax(sim, self.dtype)
@@ -252,9 +262,16 @@ class ControlledAttention(nn.Module):
 
         out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
         out = _merge_heads(out)
-        kernel_init = nn.initializers.zeros if self.zero_init_out else None
-        kwargs = {"kernel_init": kernel_init} if kernel_init is not None else {}
-        return nn.Dense(inner, dtype=self.dtype, name="to_out", **kwargs)(out)
+        return nn.Dense(inner, dtype=self.dtype, name="to_out",
+                        **self._out_kwargs())(out)
+
+    def _out_kwargs(self) -> dict:
+        kwargs = {}
+        if self.zero_init_out:
+            kwargs["kernel_init"] = nn.initializers.zeros
+        if self.row_parallel_dot is not None:
+            kwargs["dot_general"] = self.row_parallel_dot
+        return kwargs
 
 
 class FeedForward(nn.Module):
@@ -264,6 +281,8 @@ class FeedForward(nn.Module):
     dim: int
     mult: int = 4
     dtype: Dtype = jnp.float32
+    # explicit Megatron row-parallel proj_out (see FrameAttention)
+    row_parallel_dot: Optional[Callable] = None
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -271,7 +290,9 @@ class FeedForward(nn.Module):
         h = nn.Dense(inner * 2, dtype=self.dtype, name="proj_geglu")(x)
         h, gate = jnp.split(h, 2, axis=-1)
         h = h * nn.gelu(gate)
-        return nn.Dense(self.dim, dtype=self.dtype, name="proj_out")(h)
+        rp = ({"dot_general": self.row_parallel_dot}
+              if self.row_parallel_dot is not None else {})
+        return nn.Dense(self.dim, dtype=self.dtype, name="proj_out", **rp)(h)
 
 
 class BasicTransformerBlock(nn.Module):
@@ -287,6 +308,11 @@ class BasicTransformerBlock(nn.Module):
     # sequence-parallel temporal kernel (ring attention) for uncontrolled
     # passes over a sharded frame axis
     temporal_attention_fn: Optional[Callable] = None
+    # explicit Megatron row-parallel outputs: threaded to the SPATIAL sites
+    # (frame attn, cross attn, FF) whose token axis is free for the
+    # psum_scatter; the temporal site's tokens are frames — that axis
+    # belongs to the ``frames`` mesh axis and stays declarative
+    row_parallel_dot: Optional[Callable] = None
 
     @nn.compact
     def __call__(
@@ -300,7 +326,8 @@ class BasicTransformerBlock(nn.Module):
         h = nn.LayerNorm(dtype=self.dtype, name="norm1")(x)
         x = x + FrameAttention(
             heads=self.heads, dim_head=self.dim_head, dtype=self.dtype,
-            attention_fn=self.frame_attention_fn, name="attn1",
+            attention_fn=self.frame_attention_fn,
+            row_parallel_dot=self.row_parallel_dot, name="attn1",
         )(h)
 
         if context is not None:
@@ -314,11 +341,13 @@ class BasicTransformerBlock(nn.Module):
                 ctx_flat = context.reshape(b * f, *context.shape[2:])
             attn2 = ControlledAttention(
                 heads=self.heads, dim_head=self.dim_head, site="cross",
-                dtype=self.dtype, name="attn2",
+                dtype=self.dtype, row_parallel_dot=self.row_parallel_dot,
+                name="attn2",
             )(h, context=ctx_flat, control=control, video_length=f)
             x = x + attn2.reshape(b, f, n, c)
 
-        x = x + FeedForward(self.dim, dtype=self.dtype, name="ff")(
+        x = x + FeedForward(self.dim, dtype=self.dtype,
+                            row_parallel_dot=self.row_parallel_dot, name="ff")(
             nn.LayerNorm(dtype=self.dtype, name="norm3")(x)
         )
 
@@ -349,6 +378,7 @@ class Transformer3DModel(nn.Module):
     group_norm_fn: Optional[Callable] = None
     frame_attention_fn: Optional[Callable] = None
     temporal_attention_fn: Optional[Callable] = None
+    row_parallel_dot: Optional[Callable] = None
 
     @nn.compact
     def __call__(
@@ -380,9 +410,12 @@ class Transformer3DModel(nn.Module):
                 dim=inner, heads=self.heads, dim_head=self.dim_head,
                 dtype=self.dtype, frame_attention_fn=self.frame_attention_fn,
                 temporal_attention_fn=self.temporal_attention_fn,
+                row_parallel_dot=self.row_parallel_dot,
                 name=f"blocks_{i}",
             )(h, context=context, control=control)
 
         h = h.reshape(b, f, hh, ww, inner)
-        h = nn.Dense(c, dtype=self.dtype, name="proj_out")(h)
+        rp = ({"dot_general": self.row_parallel_dot}
+              if self.row_parallel_dot is not None else {})
+        h = nn.Dense(c, dtype=self.dtype, name="proj_out", **rp)(h)
         return h + residual

@@ -173,6 +173,11 @@ class UNet3DConditionModel(nn.Module):
     # sites it does not cover fall back to the two-pass XLA math, never to
     # the naked Pallas path pjit cannot partition
     group_norm_fn: Optional[Callable] = None
+    # explicit Megatron row-parallel output projections
+    # (parallel.make_megatron_out_dot): replaces the to_out/proj_out
+    # matmuls' all-reduce with a psum_scatter over the token axis on
+    # tensor-parallel meshes; None → declarative GSPMD (the default)
+    row_parallel_dot: Optional[Callable] = None
 
     @nn.compact
     def __call__(
@@ -226,6 +231,7 @@ class UNet3DConditionModel(nn.Module):
                 dtype=self.dtype,
                 frame_attention_fn=frame_attention_fn,
                 temporal_attention_fn=self.temporal_attention_fn,
+                row_parallel_dot=self.row_parallel_dot,
                 name=f"down_blocks_{i}",
             )
             if block_type == "CrossAttnDownBlock3D":
@@ -253,6 +259,7 @@ class UNet3DConditionModel(nn.Module):
             dtype=self.dtype,
             frame_attention_fn=frame_attention_fn,
             temporal_attention_fn=self.temporal_attention_fn,
+            row_parallel_dot=self.row_parallel_dot,
             name="mid_block",
         )(x, temb, encoder_hidden_states, control)
 
@@ -280,6 +287,7 @@ class UNet3DConditionModel(nn.Module):
                 dtype=self.dtype,
                 frame_attention_fn=frame_attention_fn,
                 temporal_attention_fn=self.temporal_attention_fn,
+                row_parallel_dot=self.row_parallel_dot,
                 name=f"up_blocks_{i}",
             )
             if block_type == "CrossAttnUpBlock3D":

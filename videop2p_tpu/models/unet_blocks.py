@@ -51,6 +51,7 @@ class CrossAttnDownBlock3D(nn.Module):
     dtype: Dtype = jnp.float32
     frame_attention_fn: Optional[Callable] = None
     temporal_attention_fn: Optional[Callable] = None
+    row_parallel_dot: Optional[Callable] = None
 
     @nn.compact
     def __call__(
@@ -77,6 +78,7 @@ class CrossAttnDownBlock3D(nn.Module):
                 dtype=self.dtype,
                 frame_attention_fn=self.frame_attention_fn,
                 temporal_attention_fn=self.temporal_attention_fn,
+                row_parallel_dot=self.row_parallel_dot,
                 name=f"attentions_{i}",
             )(x, context=context, control=control)
             outputs.append(x)
@@ -128,6 +130,7 @@ class UNetMidBlock3DCrossAttn(nn.Module):
     dtype: Dtype = jnp.float32
     frame_attention_fn: Optional[Callable] = None
     temporal_attention_fn: Optional[Callable] = None
+    row_parallel_dot: Optional[Callable] = None
 
     @nn.compact
     def __call__(
@@ -153,6 +156,7 @@ class UNetMidBlock3DCrossAttn(nn.Module):
                 dtype=self.dtype,
                 frame_attention_fn=self.frame_attention_fn,
                 temporal_attention_fn=self.temporal_attention_fn,
+                row_parallel_dot=self.row_parallel_dot,
                 name=f"attentions_{i}",
             )(x, context=context, control=control)
             x = ResnetBlock3D(
@@ -178,6 +182,7 @@ class CrossAttnUpBlock3D(nn.Module):
     dtype: Dtype = jnp.float32
     frame_attention_fn: Optional[Callable] = None
     temporal_attention_fn: Optional[Callable] = None
+    row_parallel_dot: Optional[Callable] = None
 
     @nn.compact
     def __call__(
@@ -205,6 +210,7 @@ class CrossAttnUpBlock3D(nn.Module):
                 dtype=self.dtype,
                 frame_attention_fn=self.frame_attention_fn,
                 temporal_attention_fn=self.temporal_attention_fn,
+                row_parallel_dot=self.row_parallel_dot,
                 name=f"attentions_{i}",
             )(x, context=context, control=control)
         if self.add_upsample:
@@ -244,6 +250,7 @@ class UpBlock3D(nn.Module):
 
 _ATTN_ONLY_KWARGS = (
     "transformer_depth", "attn_heads", "frame_attention_fn", "temporal_attention_fn",
+    "row_parallel_dot",
 )
 
 

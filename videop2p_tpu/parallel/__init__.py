@@ -4,7 +4,9 @@ from videop2p_tpu.parallel.mesh import (
     AXIS_DATA,
     AXIS_FRAMES,
     AXIS_TENSOR,
+    TP_COLLECTIVES,
     latent_sharding,
+    make_megatron_out_dot,
     make_mesh,
     make_sharded_frame_attention_fn,
     make_sharded_group_norm_fn,
@@ -21,6 +23,8 @@ from videop2p_tpu.parallel.distributed import (
     phase_skew,
 )
 from videop2p_tpu.parallel.ring import (
+    RING_VARIANTS,
+    default_ring_variant,
     make_ring_temporal_fn,
     ring_attention,
     ring_attention_sharded,
@@ -30,6 +34,10 @@ __all__ = [
     "AXIS_DATA",
     "AXIS_FRAMES",
     "AXIS_TENSOR",
+    "TP_COLLECTIVES",
+    "RING_VARIANTS",
+    "default_ring_variant",
+    "make_megatron_out_dot",
     "latent_sharding",
     "make_mesh",
     "make_sharded_frame_attention_fn",

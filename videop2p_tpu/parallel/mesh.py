@@ -31,11 +31,13 @@ __all__ = [
     "AXIS_DATA",
     "AXIS_FRAMES",
     "AXIS_TENSOR",
+    "TP_COLLECTIVES",
     "make_mesh",
     "latent_sharding",
     "text_sharding",
     "replicated",
     "param_shardings",
+    "make_megatron_out_dot",
     "make_sharded_frame_attention_fn",
     "make_sharded_group_norm_fn",
     "shard_array",
@@ -44,6 +46,14 @@ __all__ = [
 AXIS_DATA = "data"
 AXIS_FRAMES = "frames"
 AXIS_TENSOR = "tensor"
+
+# how the Megatron row-parallel output projections reduce their partial
+# sums on a tensor-parallel mesh: "gspmd" = declarative (XLA inserts an
+# all-reduce), "psum_scatter" = the explicit reduce-scatter seam
+# (make_megatron_out_dot) — half the per-chip result bytes per attention
+# block, the all-gather deferred to wherever GSPMD actually needs the
+# full token axis again
+TP_COLLECTIVES = ("gspmd", "psum_scatter")
 
 
 def make_mesh(
@@ -193,8 +203,11 @@ def param_shardings(mesh: Mesh, params, *, tensor_parallel: bool = False):
     Dense kernels shard their output features over ``tensor`` (column
     parallel, (in, out) → P(None, "tensor")) and ``to_out``/``proj_out``
     kernels shard input features (row parallel, P("tensor", None)) — the
-    Megatron pairing that keeps each attention block to one psum, expressed
-    declaratively and left to XLA/GSPMD to propagate.
+    Megatron pairing that keeps each attention block to one psum. By
+    default the reduction stays declarative (GSPMD inserts an all-reduce
+    behind each row-parallel matmul); :func:`make_megatron_out_dot` makes
+    it explicit — a ``psum_scatter`` over the token axis — when the
+    ``tp_collectives="psum_scatter"`` knob is on.
     """
 
     def spec(path, leaf):
@@ -210,6 +223,82 @@ def param_shardings(mesh: Mesh, params, *, tensor_parallel: bool = False):
         return NamedSharding(mesh, P())
 
     return jax.tree_util.tree_map_with_path(spec, params)
+
+
+def make_megatron_out_dot(mesh: Mesh):
+    """Explicit Megatron row-parallel output projection: a ``dot_general``
+    replacement for the ``to_out``/``proj_out`` Denses (the
+    ``row_parallel_dot`` seam in models/attention.py).
+
+    With the kernel's rows sharded over ``tensor`` (``param_shardings``),
+    the declarative form leaves a partial-sum matmul behind which GSPMD
+    inserts an **all-reduce** of the FULL (…, tokens, C) result on every
+    chip. The explicit form computes the local partial inside ``shard_map``
+    (manual over ``tensor`` only — ``data``/``frames`` stay in GSPMD's
+    hands via ``auto``) and reduces with ``lax.psum_scatter`` along the
+    token axis: each chip receives 1/tp of the result bytes (the
+    reduce-scatter half of the all-reduce), and the all-gather half is
+    deferred to wherever the partitioner actually needs the full token
+    axis again — often past the residual/LayerNorm elementwise ops, which
+    is the overlap-via-collective-matmul decomposition (Wang et al., 2023)
+    expressed at the seam. ``obs/comm.py`` sees the swap directly:
+    ``all_reduce_count`` drops, ``reduce_scatter_bytes`` is the all-reduce
+    bytes ÷ tp.
+
+    The returned callable falls back to the plain ``dot_general`` whenever
+    the pattern is not the row-parallel Dense matmul it models (batched
+    dims, non-2D kernel, token/feature axes not divisible by tp, tp == 1)
+    — so it is always safe to thread.
+    """
+    from videop2p_tpu.parallel.ring import shard_map_compat
+
+    tp = mesh.shape[AXIS_TENSOR]
+    auto = frozenset(a for a in mesh.axis_names if a != AXIS_TENSOR)
+
+    def dot(lhs, rhs, dimension_numbers, precision=None,
+            preferred_element_type=None, **kwargs):
+        def plain(l, r):
+            return jax.lax.dot_general(
+                l, r, dimension_numbers, precision=precision,
+                preferred_element_type=preferred_element_type, **kwargs,
+            )
+
+        (lc, rc), (lb, rb) = dimension_numbers
+        if (
+            tp <= 1
+            or lb or rb
+            or getattr(rhs, "ndim", 0) != 2
+            or getattr(lhs, "ndim", 0) < 2
+            or tuple(lc) != (lhs.ndim - 1,)
+            or tuple(rc) != (0,)
+            or lhs.shape[-1] % tp
+            or lhs.shape[lhs.ndim - 2] % tp
+            # partial-auto shard_map only exists under a surrounding jit
+            # trace on legacy jax; eager calls take the plain dot (the
+            # seam is a compiled-program optimization — eager numerics
+            # are identical either way)
+            or not isinstance(lhs, jax.core.Tracer)
+        ):
+            return plain(lhs, rhs)
+        tok = lhs.ndim - 2
+
+        def local(l, r):
+            part = plain(l, r)
+            return jax.lax.psum_scatter(
+                part, AXIS_TENSOR, scatter_dimension=tok, tiled=True
+            )
+
+        lhs_spec = P(*([None] * (lhs.ndim - 1)), AXIS_TENSOR)
+        out_parts = [None] * lhs.ndim
+        out_parts[tok] = AXIS_TENSOR
+        return shard_map_compat(
+            local, mesh=mesh,
+            in_specs=(lhs_spec, P(AXIS_TENSOR, None)),
+            out_specs=P(*out_parts),
+            auto=auto,
+        )(lhs, rhs)
+
+    return dot
 
 
 def shard_array(x: jax.Array, sharding: NamedSharding) -> jax.Array:

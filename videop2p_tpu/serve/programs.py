@@ -69,6 +69,14 @@ class ProgramSpec:
     # dp > 1 is the serving data axis — batched dispatches shard their
     # leading request axis across it (vmap dispatch mode)
     mesh: Optional[str] = None
+    # sharded-program schedule knobs (parallel/ring.py, parallel/mesh.py):
+    # the ring rotation variant on sequence-parallel meshes and the
+    # Megatron reduce-scatter seam on tensor-parallel ones. Both enter the
+    # fingerprint — a ring/tp schedule change builds DIFFERENT compiled
+    # programs, and a warm set keyed without them would silently serve the
+    # old schedule (or collide two specs onto one store namespace)
+    ring_variant: str = "overlap"
+    tp_collectives: str = "gspmd"
     # serving is the cached fast path: no null-text backward, so no remat
     gradient_checkpointing: bool = False
 
@@ -92,7 +100,8 @@ class ProgramSpec:
                         if spec.checkpoint else "<random-init>"),
             **{k: getattr(spec, k) for k in (
                 "width", "video_len", "steps", "guidance_scale", "tiny",
-                "mixed_precision", "seed", "mesh", "gradient_checkpointing",
+                "mixed_precision", "seed", "mesh", "ring_variant",
+                "tp_collectives", "gradient_checkpointing",
             )},
         )
 
@@ -139,7 +148,11 @@ class ProgramSet:
             # model-internal sharding: the CLIs' setup_mesh wires ring
             # attention / sharded GroupNorm and shards the params (dp must
             # be 1 on this path — single-clip model parallelism)
-            self.mesh = setup_mesh(bundle, spec.mesh, spec.video_len)
+            self.mesh = setup_mesh(
+                bundle, spec.mesh, spec.video_len,
+                ring_variant=spec.ring_variant,
+                tp_collectives=spec.tp_collectives,
+            )
         elif dp > 1:
             # pure serving data parallelism: params replicate, batched
             # dispatches shard their leading request axis over "data".
