@@ -457,8 +457,12 @@ def test_report_and_obs_import_only_stdlib_numpy_jax():
     # ISSUE 9 pin: the resilience layer (fault injection, breaker, retry)
     # joins the guarded set — chaos machinery must run anywhere the engine
     # does, so it stays stdlib
+    # ISSUE 11 pin: the fleet tier (pluggable schedulers, the replica
+    # supervisor and the router) joins too — the router must deploy on any
+    # box with nothing beyond the stdlib HTTP stack
     assert {"engine.py", "store.py", "batching.py", "programs.py",
-            "http.py", "client.py", "faults.py"} <= set(serve_files)
+            "http.py", "client.py", "faults.py", "sched.py", "replica.py",
+            "router.py"} <= set(serve_files)
     files += [os.path.join(serve_dir, f) for f in serve_files]
     offenders = []
     for path in files:
@@ -664,6 +668,53 @@ def test_fault_and_serve_health_ledger_event_schema(tmp_path):
     assert rel["error_rate"] == round(1 / 3, 4)
     # pre-PR-9 ledgers extract an empty (but present) reliability section
     assert extract_run([{"event": "run_start"}])["reliability"] == {}
+
+
+def test_router_and_tenant_ledger_event_schema(tmp_path):
+    """Schema pin (ISSUE 11): the ``router_health`` event and the
+    per-tenant ``serve_health`` sub-records carry their documented field
+    sets, and obs/history.py flattens both into the reliability section —
+    the fleet's obs_diff gates key on these names."""
+    from videop2p_tpu.obs import RunLedger, read_ledger
+    from videop2p_tpu.obs.history import extract_run, split_runs
+    from videop2p_tpu.serve.faults import (
+        SERVE_HEALTH_FIELDS,
+        SERVE_TENANT_FIELDS,
+    )
+    from videop2p_tpu.serve.router import ROUTER_HEALTH_FIELDS
+
+    health = {k: 0 for k in SERVE_HEALTH_FIELDS}
+    health.update(requests=4, done=3, errors=1, error_rate=0.25)
+    tenants = {
+        "A": {k: 0 for k in SERVE_TENANT_FIELDS},
+        "B": {**{k: 0 for k in SERVE_TENANT_FIELDS},
+              "shed": 2, "shed_rate": 0.5},
+    }
+    router = {k: 0 for k in ROUTER_HEALTH_FIELDS}
+    router.update(replicas=2, healthy=1, routed_around=3,
+                  per_replica={"replica0": 1, "replica1": 3})
+    path = str(tmp_path / "ledger.jsonl")
+    with RunLedger(path) as led:
+        led.event("serve_health", tenants=tenants, **health)
+        led.event("router_health", **router)
+    by_kind = {e["event"]: e for e in read_ledger(path)}
+    assert set(SERVE_TENANT_FIELDS) <= set(by_kind["serve_health"]["tenants"]["A"])
+    assert set(ROUTER_HEALTH_FIELDS) <= set(by_kind["router_health"])
+    rec = extract_run(split_runs(read_ledger(path))[-1])
+    rel = rec["reliability"]
+    # the fleet summary and every tenant lane get their own labels, so
+    # FAULT_RULES (error_rate/shed_rate/...) gate each one independently
+    assert {"serve", "serve:tenant:A", "serve:tenant:B", "router"} <= set(rel)
+    assert set(SERVE_TENANT_FIELDS) <= set(rel["serve:tenant:B"])
+    assert rel["serve:tenant:B"]["shed_rate"] == 0.5
+    assert set(ROUTER_HEALTH_FIELDS) <= set(rel["router"])
+    assert rel["router"]["routed_around"] == 3.0
+    # engine-side constants agree with the ledger surface: the engine's
+    # per-tenant records carry exactly the pinned keys
+    from videop2p_tpu.serve.engine import EditEngine
+
+    assert set(EditEngine._TENANT_COUNTER_KEYS) | {"error_rate", "shed_rate"} \
+        == set(SERVE_TENANT_FIELDS)
 
 
 def test_no_wall_clock_in_timed_regions():

@@ -378,7 +378,10 @@ def test_chaos_acceptance_engine_survives_injected_outage(make_engine,
     health = eng.health_record()
     from videop2p_tpu.serve.faults import SERVE_HEALTH_FIELDS
 
-    assert set(health) == set(SERVE_HEALTH_FIELDS)
+    # the ISSUE-11 QoS fields ride alongside the numeric pins: the
+    # scheduler policy name and the per-tenant sub-records
+    assert set(health) == set(SERVE_HEALTH_FIELDS) | {"scheduler", "tenants"}
+    assert health["scheduler"] == "drain"
     assert health["done"] == 3 and health["errors"] == 1
     assert health["deadline_exceeded"] == 1
     assert health["rejected_unavailable"] == 1

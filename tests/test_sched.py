@@ -1,0 +1,614 @@
+"""Fleet-serving tests (ISSUE 11): the pluggable scheduler policies
+(``drain`` pinned bit-exact vs the pre-scheduler engine, ``continuous``
+iteration-level admission, ``fair`` deficit-round-robin QoS with the
+deficit sequence pinned), per-tenant accounting, the batch-order knob,
+the multi-replica fleet (cross-replica disk store-hit with zero compile
+events), the router (load balancing, breaker avoidance, fleet
+aggregation, the 2-replica chaos acceptance), and the loadgen's
+per-tenant workload mix.
+"""
+
+import importlib.util
+import os
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+import jax
+
+from videop2p_tpu.serve.batching import Batch, plan_batches
+from videop2p_tpu.serve.sched import (
+    SCHEDULER_POLICIES,
+    ContinuousScheduler,
+    DrainScheduler,
+    FairScheduler,
+    TenantConfig,
+    make_scheduler,
+    parse_tenants,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        f"{name}_under_sched_test", os.path.join(_REPO, "tools", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _item(compat, seq, *, tenant="default", deadline_at=None, arrival_s=None):
+    return types.SimpleNamespace(
+        compat=compat, seq=seq, tenant=tenant, deadline_at=deadline_at,
+        arrival_s=seq * 0.001 if arrival_s is None else arrival_s,
+    )
+
+
+# ------------------------------------------------------- tenant config --
+
+
+def test_parse_tenants_syntax_and_errors():
+    assert parse_tenants(None) == {}
+    assert parse_tenants("") == {}
+    cfg = parse_tenants("A:5,B:1")
+    assert cfg["A"] == TenantConfig(weight=5)
+    assert cfg["B"] == TenantConfig(weight=1)
+    cfg = parse_tenants("gold:8:0,free:1:2")
+    assert cfg["gold"].priority == 0 and cfg["free"].priority == 2
+    cfg = parse_tenants('{"A": {"weight": 3, "deadline_s": 2.5}}')
+    assert cfg["A"] == TenantConfig(weight=3, deadline_s=2.5)
+    with pytest.raises(ValueError, match="name:weight"):
+        parse_tenants(":5")
+    with pytest.raises(ValueError, match="bad tenant spec"):
+        parse_tenants("A:x")
+    with pytest.raises(ValueError, match="unknown tenant config"):
+        parse_tenants('{"A": {"wight": 3}}')
+    with pytest.raises(ValueError, match="weight must be >= 1"):
+        TenantConfig(weight=0)
+
+
+# -------------------------------------------------- plan_batches order --
+
+
+def test_plan_batches_default_order_unchanged_and_oldest_reorders():
+    """Satellite pin: the default plan is byte-identical to the
+    pre-ISSUE-11 grouping (first-seen-key chunk order), and
+    ``order="oldest"`` reorders CHUNKS by their oldest member so an early
+    rare-key singleton no longer delays the dominant key's batch."""
+    class It:
+        def __init__(self, compat, tag):
+            self.compat = compat
+            self.tag = tag
+
+    # rare key "r" arrives first, then the dominant "d" flood, then a
+    # second "r" straggler that lands in the first r-chunk
+    items = [It("r", 0), It("d", 1), It("d", 2), It("d", 3), It("d", 4),
+             It("d", 5), It("r", 6)]
+    default = plan_batches(items, max_batch=4)
+    assert [(p.key, [i.tag for i in p.items]) for p in default] == [
+        ("r", [0, 6]), ("d", [1, 2, 3, 4]), ("d", [5]),
+    ]
+    oldest = plan_batches(items, max_batch=4, order="oldest")
+    # same chunks, dispatch order now by oldest member: r(0) then d(1)
+    # then d(5) — and with the rare head REMOVED, the dominant batch jumps
+    # the singleton straggler
+    assert [(p.key, [i.tag for i in p.items]) for p in oldest] == [
+        ("r", [0, 6]), ("d", [1, 2, 3, 4]), ("d", [5]),
+    ]
+    tail = plan_batches(items[1:], max_batch=4, order="oldest")
+    assert [(p.key, [i.tag for i in p.items]) for p in tail] == [
+        ("d", [1, 2, 3, 4]), ("d", [5]), ("r", [6]),
+    ]
+    # explicit arrival values override positional order (reversed clock:
+    # the d[5] singleton chunk now predates the d[1..4] chunk)
+    arr = plan_batches(
+        items, max_batch=4, order="oldest",
+        arrival_fn=lambda it: 10 - it.tag,
+    )
+    assert [(p.key, [i.tag for i in p.items]) for p in arr] == [
+        ("r", [0, 6]), ("d", [5]), ("d", [1, 2, 3, 4]),
+    ]
+    with pytest.raises(ValueError, match="first_seen.*oldest"):
+        plan_batches(items, order="newest")
+
+
+# --------------------------------------------------- scheduler units ----
+
+
+def test_make_scheduler_factory_and_validation():
+    assert set(SCHEDULER_POLICIES) == {"drain", "continuous", "fair"}
+    for policy, cls in (("drain", DrainScheduler),
+                        ("continuous", ContinuousScheduler),
+                        ("fair", FairScheduler)):
+        s = make_scheduler(policy, max_batch=2)
+        assert isinstance(s, cls) and s.name == policy
+        assert s.snapshot()["policy"] == policy
+    with pytest.raises(ValueError, match="unknown scheduler"):
+        make_scheduler("lifo")
+    with pytest.raises(ValueError, match="first_seen"):
+        make_scheduler("drain", order="newest")
+    # drain keeps the plan boundary; continuous/fair re-collect per batch
+    assert not DrainScheduler().preemptive
+    assert ContinuousScheduler().preemptive and FairScheduler().preemptive
+
+
+def test_drain_scheduler_plans_exactly_like_plan_batches():
+    """The bit-exact compatibility baseline at the unit level: the drain
+    policy's batch sequence IS plan_batches over the collected window —
+    same grouping, same chunking, same padding, same order."""
+    sched = DrainScheduler(max_batch=4)
+    items = [_item("a", 1), _item("b", 2), _item("a", 3), _item("a", 4),
+             _item("b", 5), _item("a", 6), _item("a", 7)]
+    sched.add(items)
+    got = []
+    while True:
+        plan = sched.next_plan(0.0, queue_empty=True)
+        if plan is None:
+            break
+        got.append((plan.key, [p.seq for p in plan.items], plan.padded_size))
+    want = [(b.key, [p.seq for p in b.items], b.padded_size)
+            for b in plan_batches(items, max_batch=4)]
+    assert got == want
+    assert got == [("a", [1, 3, 4, 6], 4), ("a", [7], 1), ("b", [2, 5], 2)]
+    assert sched.pending() == 0
+
+
+def test_continuous_scheduler_deadline_order_and_partial_dispatch():
+    now = 100.0
+    sched = ContinuousScheduler(max_batch=4)
+    # an urgent deadline jumps an earlier undeadlined arrival
+    sched.add([_item("a", 1), _item("b", 2, deadline_at=now + 0.5),
+               _item("a", 3)])
+    plan = sched.next_plan(now, queue_empty=True)
+    assert plan.key == "b" and [p.seq for p in plan.items] == [2]
+    # remaining "a" items form a partial batch, dispatched immediately
+    # because nothing else is queued
+    plan = sched.next_plan(now, queue_empty=True)
+    assert plan.key == "a" and [p.seq for p in plan.items] == [1, 3]
+    assert plan.padded_size == 2
+    assert sched.next_plan(now, queue_empty=True) is None
+    # with more work already queued, a partial batch HOLDS so the queued
+    # work can join the dispatch (iteration-level admission)
+    sched.add([_item("a", 4)])
+    assert sched.next_plan(now, queue_empty=False) is None
+    sched.add([_item("a", 5), _item("a", 6), _item("a", 7)])
+    plan = sched.next_plan(now, queue_empty=False)
+    assert [p.seq for p in plan.items] == [4, 5, 6, 7]  # full → dispatch
+
+
+def test_continuous_scheduler_bounded_formation_wait():
+    sched = ContinuousScheduler(max_batch=4, max_batch_wait_s=0.2)
+    sched.add([_item("a", 1, arrival_s=10.0)])
+    # inside the hold window a partial batch waits for fill...
+    assert sched.next_plan(10.1, queue_empty=True) is None
+    # ...but the wait is BOUNDED: past it, the partial dispatches
+    plan = sched.next_plan(10.25, queue_empty=True)
+    assert [p.seq for p in plan.items] == [1]
+
+
+def test_fair_scheduler_deficit_round_robin_pinned():
+    """THE fair-queuing pin: tenants A (weight 5) and B (weight 1) under
+    saturation. The DRR grant/spend sequence — and therefore the exact
+    per-batch tenant interleave — is deterministic and pinned: B's lane
+    gets service every round even though A outweighs it 5:1 (nonzero
+    starved-tenant throughput), and the deficit counters take exactly the
+    grant − spend values."""
+    sched = FairScheduler(
+        max_batch=4, tenants=parse_tenants("A:5,B:1"),
+    )
+    sched.add([_item("x", i, tenant="A") for i in range(1, 8)])     # 7 A's
+    sched.add([_item("x", i, tenant="B") for i in range(101, 104)])  # 3 B's
+    seq = []
+    deficits = []
+    while sched.pending():
+        plan = sched.next_plan(0.0, queue_empty=True)
+        seq.append((plan.items[0].tenant, [p.seq for p in plan.items]))
+        deficits.append(dict(sched._deficit))
+    # round 1: grant A+=5, B+=1 → A spends 4 (max_batch cap) then 1;
+    # B spends its 1; round 2: grant again → A finishes (lane empties →
+    # deficit resets), B drains on its accumulated credit
+    assert seq == [
+        ("A", [1, 2, 3, 4]),
+        ("A", [5]),
+        ("B", [101]),
+        ("A", [6, 7]),
+        ("B", [102]),
+        ("B", [103]),
+    ]
+    # pinned counters after each batch (A's entry disappears when its
+    # lane empties — classic DRR reset)
+    assert deficits[0] == {"A": 1.0, "B": 1.0}
+    assert deficits[1] == {"A": 0.0, "B": 1.0}
+    assert deficits[2] == {"A": 0.0, "B": 0.0}
+    assert deficits[3] == {"B": 1.0}
+    assert deficits[4] == {"B": 0.0}
+    assert deficits[5] == {}
+    # B was served before A's backlog drained: nonzero throughput for the
+    # starved low-weight tenant
+    assert seq[2][0] == "B" and any(t == "A" for t, _ in seq[3:])
+
+
+def test_fair_scheduler_priority_orders_within_round():
+    sched = FairScheduler(max_batch=2,
+                          tenants=parse_tenants("low:1:5,high:1:0"))
+    sched.add([_item("x", 1, tenant="low"), _item("x", 2, tenant="high")])
+    first = sched.next_plan(0.0, queue_empty=True)
+    second = sched.next_plan(0.0, queue_empty=True)
+    # equal weights: priority 0 scans first, but the low lane still
+    # drains in the same round (no starvation)
+    assert first.items[0].tenant == "high"
+    assert second.items[0].tenant == "low"
+
+
+def test_tenant_cycle_deterministic_weighted_mix():
+    loadgen = _load_tool("serve_loadgen")
+    cyc = loadgen.tenant_cycle({"A": 3, "B": 1}, 8)
+    assert cyc == loadgen.tenant_cycle({"A": 3, "B": 1}, 8)  # deterministic
+    assert cyc.count("A") == 6 and cyc.count("B") == 2       # exact ratio
+    assert cyc[0] == "A" and "B" in cyc[:4]                  # interleaved
+    assert loadgen.tenant_cycle({}, 3) == ["default"] * 3
+    assert loadgen.parse_tenant_weights("A:5,B:1") == {"A": 5, "B": 1}
+    with pytest.raises(ValueError, match="name:weight"):
+        loadgen.parse_tenant_weights(":3")
+
+
+# ------------------------------------------------ engines (tiny, CPU) ---
+
+_SPEC_KW = dict(checkpoint=None, tiny=True, width=16, video_len=2, steps=2)
+_PROMPTS = ("a rabbit is jumping", "a origami rabbit is jumping")
+
+
+@pytest.fixture(scope="module")
+def programs():
+    """One warm tiny ProgramSet shared by every engine in this module —
+    single-host replicas share compiled programs exactly like this."""
+    from videop2p_tpu.serve import ProgramSet, ProgramSpec
+
+    ps = ProgramSet(ProgramSpec(**_SPEC_KW))
+    ps.warm(_PROMPTS, batch_sizes=(2,))
+    return ps
+
+
+def _request(**overrides):
+    from videop2p_tpu.serve import EditRequest
+
+    kw = dict(image_path="data/rabbit", prompt=_PROMPTS[0],
+              prompts=list(_PROMPTS), save_name="sched")
+    kw.update(overrides)
+    return EditRequest(**kw)
+
+
+def _engine(programs, tmp_root, name, **kw):
+    from videop2p_tpu.serve import EditEngine, ProgramSpec
+
+    return EditEngine(
+        ProgramSpec(**_SPEC_KW), out_dir=os.path.join(tmp_root, name),
+        programs=programs, keep_videos=True, **kw,
+    )
+
+
+def test_drain_engine_pinned_bit_exact_vs_direct_program(programs, tmp_path):
+    """THE compatibility acceptance: the drain-scheduler engine's output
+    is BIT-IDENTICAL to the direct warm-program dispatch (exactly what the
+    pre-scheduler engine executed per request), the cached replay keeps
+    ``src_err == 0.0``, and a repeat request is a store hit with zero new
+    compile events."""
+    from videop2p_tpu.data import load_frame_sequence
+
+    ps = programs
+    # the golden: resolve + dispatch by hand through the same warm
+    # programs the pre-refactor engine drove
+    frames = load_frame_sequence("data/rabbit", size=ps.spec.width,
+                                 num_frames=ps.spec.video_len)
+    latents = ps.encode(ps.frames_to_video(frames), jax.random.key(0))
+    _, ik = jax.random.split(jax.random.key(0))
+    ctx = ps.controller(list(_PROMPTS))
+    cached = ps.invert_capture(
+        latents, ps.encode_prompts(list(_PROMPTS[:1])), ctx, ik
+    )[1]
+    golden, golden_err = ps.edit_decode(
+        cached, ps.encode_prompts(list(_PROMPTS)),
+        ps.encode_prompts([""])[0], ctx, latents,
+    )
+    eng = _engine(ps, str(tmp_path), "drain", scheduler="drain")
+    try:
+        assert eng.scheduler.name == "drain"
+        r1 = eng.result(eng.submit(_request()), wait_s=300.0)
+        assert r1["status"] == "done", r1.get("error")
+        assert r1["src_err"] == 0.0 and float(golden_err) == 0.0
+        assert np.array_equal(eng.videos(r1["id"]), np.asarray(golden))
+        r2 = eng.result(eng.submit(_request()), wait_s=300.0)
+        assert r2["status"] == "done" and r2["store_hit"] is True
+        assert r2["compile_events"] == 0 and r2["src_err"] == 0.0
+        assert np.array_equal(eng.videos(r2["id"]), np.asarray(golden))
+        # queue-wait telemetry landed (the continuous-vs-drain metric)
+        assert r2["queue_wait_s"] >= 0.0
+        assert eng.health_record()["queue_wait_mean_s"] >= 0.0
+    finally:
+        eng.close()
+
+
+def test_continuous_engine_admits_midflight_requests(programs, tmp_path):
+    """The iteration-level-admission acceptance: requests arriving while
+    the worker is busy join ONE later dispatch (observed batch_size > 1)
+    instead of draining as singletons the way drain with a zero window
+    would."""
+    eng = _engine(programs, str(tmp_path), "cont", scheduler="continuous",
+                  max_batch=4)
+    try:
+        # first request occupies the worker (fresh inversion of the clip)
+        r1 = eng.submit(_request(seed=31))
+        # these arrive mid-flight; the continuous policy collects them
+        # together after the in-flight dispatch and batches them
+        r2 = eng.submit(_request(seed=32))
+        r3 = eng.submit(_request(seed=32, save_name="sched2"))
+        recs = [eng.result(r, wait_s=300.0) for r in (r1, r2, r3)]
+        for rec in recs:
+            assert rec["status"] == "done", rec.get("error")
+            assert rec["src_err"] == 0.0
+        assert max(rec["batch_size"] for rec in recs) >= 2
+        assert eng.health_record()["scheduler"] == "continuous"
+    finally:
+        eng.close()
+
+
+def test_fair_engine_serves_both_tenants_with_accounting(programs, tmp_path):
+    """Fair policy end-to-end: a saturating high-weight tenant cannot
+    starve the low-weight one, and per-tenant outcomes land in
+    health_record()/metrics() (the serve_health "tenants" map)."""
+    eng = _engine(programs, str(tmp_path), "fair", scheduler="fair",
+                  tenants="A:5,B:1", max_batch=2)
+    try:
+        rids = [eng.submit(_request(seed=41, tenant="A")) for _ in range(4)]
+        rids += [eng.submit(_request(seed=41, tenant="B"))]
+        rids += [eng.submit(_request(seed=41, tenant="A")) for _ in range(2)]
+        recs = [eng.result(r, wait_s=300.0) for r in rids]
+        for rec in recs:
+            assert rec["status"] == "done", rec.get("error")
+        health = eng.health_record()
+        assert health["scheduler"] == "fair"
+        tenants = health["tenants"]
+        assert tenants["A"]["done"] == 6 and tenants["B"]["done"] == 1
+        assert tenants["B"]["error_rate"] == 0.0
+        assert eng.metrics()["scheduler"]["policy"] == "fair"
+        # the per-tenant deadline budget applies where the request has none
+        eng2 = _engine(programs, str(tmp_path), "fair2", scheduler="fair",
+                       tenants='{"slow": {"weight": 1, "deadline_s": 99.0}}')
+        try:
+            rid = eng2.submit(_request(seed=41, tenant="slow"))
+            assert eng2.poll(rid)["deadline_s"] == 99.0
+            assert eng2.result(rid, wait_s=300.0)["status"] == "done"
+        finally:
+            eng2.close()
+    finally:
+        eng.close()
+
+
+def test_continuous_queue_wait_below_drain_on_same_trace(programs, tmp_path):
+    """The ISSUE-11 latency acceptance: on the same closed-loop trace the
+    continuous policy's mean queue wait is below drain's (drain holds
+    every lone request for its full admit window; continuous dispatches
+    the moment the queue is idle) — recorded in the ledger and gated
+    through obs_diff (self-compare exit 0)."""
+    loadgen = _load_tool("serve_loadgen")
+    req = _request(seed=51).to_dict()
+    waits = {}
+    ledgers = {}
+    for policy, kw in (("drain", dict(max_wait_s=0.25)),
+                       ("continuous", dict())):
+        eng = _engine(programs, str(tmp_path), f"qw_{policy}",
+                      scheduler=policy, **kw)
+        try:
+            ledger_path = str(tmp_path / f"qw_{policy}.jsonl")
+            record = loadgen.run_loadgen(
+                loadgen._InprocTarget(eng, timeout_s=300.0), req,
+                requests=3, concurrency=1, ledger_path=ledger_path,
+                meta={"scheduler": policy},
+                collect_extra=lambda rec, eng=eng: [
+                    {"event": "serve_health", **eng.health_record()}
+                ],
+            )
+            assert record["done"] == 3, record
+            waits[policy] = eng.health_record()["queue_wait_mean_s"]
+            ledgers[policy] = ledger_path
+        finally:
+            eng.close()
+    # drain waited its 0.25 s window per lone request; continuous ~0
+    assert waits["continuous"] < waits["drain"], waits
+    # the metric is in the ledger (serve_health.queue_wait_mean_s) and the
+    # run gates clean through obs_diff
+    from videop2p_tpu.obs import read_ledger
+    from videop2p_tpu.obs.history import extract_run, split_runs
+
+    for policy, path in ledgers.items():
+        rel = extract_run(split_runs(read_ledger(path))[-1])["reliability"]
+        assert rel["serve"]["queue_wait_mean_s"] == pytest.approx(
+            waits[policy], abs=1e-3)
+    obs_diff = _load_tool("obs_diff")
+    assert obs_diff.main(["obs_diff.py", ledgers["continuous"],
+                          ledgers["continuous"]]) == 0
+
+
+# --------------------------------------------------- fleet + router -----
+
+
+@pytest.fixture(scope="module")
+def fleet(programs, tmp_path_factory):
+    """Two inproc replicas over ONE shared disk inversion-store root,
+    behind a router's HTTP front door."""
+    from videop2p_tpu.serve import ReplicaSupervisor, Router, RouterServer
+
+    root = tmp_path_factory.mktemp("fleet")
+    sup = ReplicaSupervisor(
+        programs.spec, 2, out_dir=str(root), programs=programs,
+        warm_prompts=_PROMPTS, engine_kwargs=dict(keep_videos=True),
+    )
+    sup.start()
+    router = Router(sup.urls, probe_ttl_s=0.05,
+                    ledger_path=str(root / "router_ledger.jsonl"))
+    server = RouterServer(router).start()
+    yield sup, router, server
+    server.close()
+    sup.stop()
+
+
+def test_cross_replica_disk_store_hit_zero_compiles(fleet):
+    """THE fleet acceptance: a request inverted on replica A is a DISK
+    store-hit on replica B (shared content-addressed root) — rebuilt
+    through B's warm programs with src_err == 0.0, ZERO new compile
+    events, no fresh inversion-from-frames, and bit-identical videos."""
+    sup, _, _ = fleet
+    eng_a = sup.replicas[0].engine
+    eng_b = sup.replicas[1].engine
+    req = _request(seed=61)
+    ra = eng_a.result(eng_a.submit(req), wait_s=300.0)
+    assert ra["status"] == "done", ra.get("error")
+    assert ra["store_source"] == "fresh" and ra["src_err"] == 0.0
+    rb = eng_b.result(eng_b.submit(_request(seed=61)), wait_s=300.0)
+    assert rb["status"] == "done", rb.get("error")
+    assert rb["store_hit"] is True and rb["store_source"] == "disk"
+    assert rb["src_err"] == 0.0
+    assert rb["compile_events"] == 0
+    assert eng_b.counters["rehydrations"] == 1
+    assert eng_b.counters["fresh_inversions"] == 0
+    assert rb["store_key"] == ra["store_key"]
+    assert np.array_equal(eng_a.videos(ra["id"]), eng_b.videos(rb["id"]))
+
+
+def test_router_http_roundtrip_and_fleet_aggregation(fleet):
+    from videop2p_tpu.serve.client import EngineClient, engine_available
+    from videop2p_tpu.serve.router import ROUTER_HEALTH_FIELDS
+
+    sup, router, server = fleet
+    client = EngineClient(server.url)
+    assert engine_available(server.url)
+    health = client.healthz()
+    assert health["ok"] and health["healthy"] == 2 and health["total"] == 2
+    assert set(health["replicas"]) == {"replica0", "replica1"}
+    rid = client.submit(_request(seed=62).to_dict())
+    rec = client.wait(rid, timeout_s=300.0)
+    assert rec["status"] == "done" and rec["src_err"] == 0.0
+    assert rec["replica"] in ("replica0", "replica1")
+    # the server-side wait endpoint proxies to the owning replica
+    rec_srv = client.result(rid, wait_s=5.0)
+    assert rec_srv["status"] == "done" and rec_srv["id"] == rid
+    metrics = client.metrics()
+    assert metrics["router"]["routed"] >= 1
+    assert set(metrics["replicas"]) == {"replica0", "replica1"}
+    assert metrics["requests"].get("done", 0) >= 1
+    # machine-readable surfaces: 404 unknown id, 400 malformed body
+    with pytest.raises(RuntimeError, match="404"):
+        client.poll("feedfacefeed")
+    with pytest.raises(RuntimeError, match="400"):
+        client.submit({"prompt": "a", "bogus": True})
+    record = router.health_record()
+    assert set(ROUTER_HEALTH_FIELDS) <= set(record)
+    assert record["replicas"] == 2 and record["routed"] >= 1
+
+
+def test_router_chaos_sheds_to_healthy_replica(programs, tmp_path):
+    """THE 2-replica chaos acceptance: replica 0 sits in a FaultPlan
+    unavailable window (every dispatch raises backend-unavailable, its
+    breaker trips OPEN), and the ROUTER keeps the fleet serving — success
+    rate over the loadgen trace stays >= the threshold because traffic
+    sheds to the healthy replica, the router's routed_around counter
+    proves the avoidance, and the run's reliability (per-replica
+    serve_health + router_health) gates through obs_diff exit 0 on
+    self-compare."""
+    from videop2p_tpu.serve import ReplicaSupervisor, Router, RouterServer
+
+    loadgen = _load_tool("serve_loadgen")
+    root = str(tmp_path)
+    sup = ReplicaSupervisor(
+        programs.spec, 2, out_dir=root, programs=programs,
+        warm_prompts=_PROMPTS,
+        engine_kwargs=dict(max_retries=0, breaker_threshold=1,
+                           breaker_open_s=60.0),
+        faults={0: "unavail@1-999"},
+    )
+    sup.start()
+    router = Router(sup.urls, probe_ttl_s=0.05, suspend_s=5.0)
+    server = RouterServer(router).start()
+    try:
+        ledger_path = str(tmp_path / "chaos.jsonl")
+
+        def collect_extra(record):
+            events = []
+            for r in sup.replicas:
+                events += [dict(e) for e in r.engine.fault_log]
+                events.append({"event": "serve_health", "label": r.name,
+                               **r.engine.health_record()})
+            record["router"] = router.health_record()
+            events.append({"event": "router_health", **record["router"]})
+            return events
+
+        record = loadgen.run_loadgen(
+            loadgen._HttpTarget(server.url, timeout_s=300.0),
+            _request(seed=63).to_dict(),
+            requests=8, concurrency=2, ledger_path=ledger_path,
+            meta={"target": "router-chaos"}, collect_extra=collect_extra,
+        )
+    finally:
+        server.close()
+        sup.stop()
+    # the faulted replica doomed at most its pre-breaker requests; the
+    # fleet stayed above threshold because the router shed to replica 1
+    assert record["success_rate"] >= 0.6, record
+    assert record["router"]["routed_around"] >= 1
+    assert record["router"]["healthy"] == 1
+    assert sup.replicas == []  # stopped
+    # replica 0's breaker genuinely opened and was ledgered
+    from videop2p_tpu.obs import read_ledger
+    from videop2p_tpu.obs.history import extract_run, split_runs
+
+    rec = extract_run(split_runs(read_ledger(ledger_path))[-1])
+    rel = rec["reliability"]
+    assert rel["replica0"]["breaker_trips"] >= 1
+    assert rel["replica1"]["errors"] == 0
+    assert rel["router"]["routed_around"] >= 1
+    obs_diff = _load_tool("obs_diff")
+    assert obs_diff.main(["obs_diff.py", ledger_path, ledger_path]) == 0
+
+
+def test_loadgen_per_tenant_mix_and_stats(programs, tmp_path):
+    """Loadgen satellite: the --tenants weighted mix assigns tenants
+    deterministically, per-tenant p50/p99 + shed-rate land in the summary
+    AND the ledger (per-tenant reservoirs + the engine's per-tenant
+    serve_health sub-records)."""
+    loadgen = _load_tool("serve_loadgen")
+    eng = _engine(programs, str(tmp_path), "mix", scheduler="fair",
+                  tenants="A:3,B:1")
+    try:
+        ledger_path = str(tmp_path / "mix.jsonl")
+        record = loadgen.run_loadgen(
+            loadgen._InprocTarget(eng, timeout_s=300.0),
+            _request(seed=71).to_dict(),
+            requests=4, concurrency=2, ledger_path=ledger_path,
+            meta={"target": "mix"},
+            tenants={"A": 3, "B": 1},
+            collect_extra=lambda rec: [
+                {"event": "serve_health", **eng.health_record()}
+            ],
+        )
+        assert record["done"] == 4
+        per = record["tenants"]
+        assert per["A"]["requests"] == 3 and per["B"]["requests"] == 1
+        assert per["A"]["done"] == 3 and per["B"]["done"] == 1
+        assert per["A"]["p50_s"] > 0.0 and per["A"]["shed_rate"] == 0.0
+        # engine-side accounting agrees with the client-side view
+        tenants = eng.health_record()["tenants"]
+        assert tenants["A"]["done"] == 3 and tenants["B"]["done"] == 1
+    finally:
+        eng.close()
+    from videop2p_tpu.obs import read_ledger
+    from videop2p_tpu.obs.history import extract_run, split_runs
+
+    rec = extract_run(split_runs(read_ledger(ledger_path))[-1])
+    assert rec["timing"]["loadgen_request_A"]["count"] == 3
+    assert rec["timing"]["loadgen_request_B"]["count"] == 1
+    assert rec["reliability"]["serve:tenant:A"]["done"] == 3

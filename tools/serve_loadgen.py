@@ -1,13 +1,18 @@
-"""Closed-loop load generator for the edit-serving engine.
+"""Closed-loop load generator for the edit-serving engine and fleet.
 
 Drives N requests at a fixed concurrency against a running engine — over
-HTTP (``--url``, a ``cli/serve.py`` process) or fully in-process
-(``--inproc``, builds a tiny/random-init engine; the CI smoke mode) — and
-writes an ``execute_timing``-compatible run ledger: per-phase client-side
-latency reservoirs (``loadgen_request`` end-to-end, ``loadgen_submit``)
-flushed through the same :class:`~videop2p_tpu.obs.timing.LatencyReservoir`
-machinery every other run record uses. Two loadgen ledgers therefore diff
-and GATE with ``tools/obs_diff.py`` (``TIMING_RULES``) like any bench run:
+HTTP (``--url``, a ``cli/serve.py`` process OR a ``cli/router.py`` fleet;
+the API is identical), fully in-process (``--inproc``, builds a
+tiny/random-init engine; the CI smoke mode), or against a self-built
+in-process FLEET (``--router N``: N replicas sharing one disk inversion
+store behind a real HTTP router) — and writes an ``execute_timing``-
+compatible run ledger: per-phase client-side latency reservoirs
+(``loadgen_request`` end-to-end, ``loadgen_submit``, plus one reservoir
+per tenant) flushed through the same
+:class:`~videop2p_tpu.obs.timing.LatencyReservoir` machinery every other
+run record uses. Two loadgen ledgers therefore diff and GATE with
+``tools/obs_diff.py`` (``TIMING_RULES`` + ``FAULT_RULES``) like any bench
+run:
 
     python tools/serve_loadgen.py --url http://host:8000 --requests 64 \
         --concurrency 8 --image data/rabbit --ledger loadgen_a.jsonl
@@ -15,20 +20,30 @@ and GATE with ``tools/obs_diff.py`` (``TIMING_RULES``) like any bench run:
 
 Closed loop = each worker submits its next request only after the previous
 one finished — the concurrency IS the offered load, so latency percentiles
-are comparable across runs without open-loop arrival modeling.
+are comparable across runs without open-loop arrival modeling. Workers are
+one blocked thread each (8 KiB of interpreter state + a parked socket), so
+thousands of closed-loop clients fit one driver process:
+``--concurrency 2000`` is 2000 live clients against the fleet.
 
-Chaos mode (ISSUE 9): ``--faults <plan>`` (``--inproc`` only) drives the
-engine under a deterministic injected fault plan (serve/faults.py DSL —
-``fail@K``, ``hang@K:S``, ``unavail@A-B``, ``corrupt:PAT``), classifies
-outcomes per terminal status (done / error / deadline_exceeded / shed),
-copies the engine's ``fault``/``breaker`` events and its ``serve_health``
-summary into the loadgen ledger (so ``tools/obs_diff.py`` gates the run's
-reliability through ``FAULT_RULES`` exactly like its latency through
-``TIMING_RULES``), and asserts the healthy-request success rate
-(``--min_success_rate``; exit 1 below it):
+Per-tenant workload mix (ISSUE 11): ``--tenants A:5,B:1`` tags requests
+with tenant names on a deterministic smooth-weighted-round-robin cycle (no
+randomness — the same flags replay the same per-request tenants), and the
+summary + ledger grow per-tenant p50/p99 latency and shed/success rates —
+the client-side view of the engine's per-tenant QoS accounting. Pair with
+``--scheduler fair`` to exercise the deficit-round-robin lanes.
 
-    python tools/serve_loadgen.py --inproc --tiny --requests 8 \
-        --faults 'fail@2,unavail@4-5' --min_success_rate 0.5
+Chaos modes (ISSUE 9 + 11): ``--faults <plan>`` (``--inproc``) injects a
+deterministic fault plan into the single engine;
+``--replica_faults IDX:PLAN`` (``--router N``) injects into ONE replica of
+the fleet — the 2-replica acceptance run takes replica 0 through an
+unavailable window and requires the ROUTER to shed traffic to the healthy
+replica, gated by ``--min_success_rate`` (exit 1 below it) with the
+engines' ``fault``/``breaker``/``serve_health`` events and the router's
+``router_health`` summary copied into the loadgen ledger for
+``tools/obs_diff.py``:
+
+    python tools/serve_loadgen.py --router 2 --tiny --requests 16 \
+        --replica_faults 0:unavail@1-999 --min_success_rate 0.6
 """
 
 from __future__ import annotations
@@ -39,7 +54,7 @@ import os
 import sys
 import threading
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO not in sys.path:
@@ -95,6 +110,45 @@ def _is_shed(exc: Exception) -> bool:
     return "HTTP 429" in msg or "HTTP 503" in msg
 
 
+def tenant_cycle(weights: Dict[str, int], n: int) -> List[str]:
+    """Deterministic smooth-weighted-round-robin tenant assignment for
+    ``n`` requests: each step every tenant gains its weight in credit, the
+    richest (ties by name) is picked and pays the total weight back. The
+    mix converges to the weight ratio with maximal interleave — and the
+    same weights always produce the same per-request tenants."""
+    if not weights:
+        return ["default"] * n
+    names = sorted(weights)
+    total = sum(max(int(weights[t]), 1) for t in names)
+    credit = {t: 0 for t in names}
+    out = []
+    for _ in range(n):
+        for t in names:
+            credit[t] += max(int(weights[t]), 1)
+        pick = max(names, key=lambda t: (credit[t], t))
+        credit[pick] -= total
+        out.append(pick)
+    return out
+
+
+def parse_tenant_weights(spec: Optional[str]) -> Dict[str, int]:
+    """``"A:5,B:1"`` → ``{"A": 5, "B": 1}`` (the workload-mix side of the
+    tenant syntax — weights only; engine-side QoS uses serve/sched.py's
+    ``parse_tenants``)."""
+    if not spec:
+        return {}
+    out = {}
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, w = part.partition(":")
+        if not name:
+            raise ValueError(f"bad tenant weight {part!r} — expected name:weight")
+        out[name] = int(w) if w else 1
+    return out
+
+
 def run_loadgen(
     target,
     request: Dict[str, Any],
@@ -104,35 +158,61 @@ def run_loadgen(
     ledger_path: Optional[str] = None,
     meta: Optional[Dict[str, Any]] = None,
     collect_extra=None,
+    tenants: Optional[Dict[str, int]] = None,
+    mutate_request=None,
 ) -> Dict[str, Any]:
     """Run the closed loop; returns the summary record (also printed as one
     JSON line by :func:`main`). When ``ledger_path`` is given, the
     reservoirs flush there as ``execute_timing`` events. ``collect_extra``
-    (chaos mode) is called after the loop and may return extra ledger
-    events (dicts with an ``"event"`` key — the engine's ``fault`` /
-    ``breaker`` trail and its ``serve_health`` summary) to write into the
-    same ledger, making the run's reliability obs_diff-gateable."""
+    (chaos/fleet mode) is called after the loop and may return extra
+    ledger events (dicts with an ``"event"`` key — the engines' ``fault``
+    / ``breaker`` trail, their ``serve_health`` summaries and the router's
+    ``router_health``) to write into the same ledger, making the run's
+    reliability obs_diff-gateable. ``tenants`` (name → weight) tags each
+    request on the deterministic :func:`tenant_cycle` and adds per-tenant
+    latency/shed accounting. ``mutate_request(req, issue_index)`` is the
+    per-request hook (``--distinct_seeds`` rides it)."""
     from videop2p_tpu.obs.timing import LatencyReservoir
 
     reservoirs = {
         "loadgen_request": LatencyReservoir(),
         "loadgen_submit": LatencyReservoir(),
     }
+    assignment = tenant_cycle(tenants or {}, requests) if tenants else None
+    tenant_names = sorted(tenants) if tenants else []
+    for t in tenant_names:
+        reservoirs[f"loadgen_request_{t}"] = LatencyReservoir()
     lock = threading.Lock()
     counters = {"done": 0, "errors": 0, "deadline_exceeded": 0, "shed": 0,
                 "store_hits": 0, "issued": 0}
+    tcounters = {t: {"requests": 0, "done": 0, "errors": 0,
+                     "deadline_exceeded": 0, "shed": 0}
+                 for t in tenant_names}
 
     def worker():
         while True:
             with lock:
                 if counters["issued"] >= requests:
                     return
+                idx = counters["issued"]
                 counters["issued"] += 1
-            try:
-                rec = target.one(dict(request))
-            except Exception as e:  # noqa: BLE001 — a failed request is a counter, not a crash
+            req = dict(request)
+            tenant = None
+            if assignment is not None:
+                tenant = assignment[idx]
+                req["tenant"] = tenant
                 with lock:
-                    counters["shed" if _is_shed(e) else "errors"] += 1
+                    tcounters[tenant]["requests"] += 1
+            if mutate_request is not None:
+                req = mutate_request(req, idx)
+            try:
+                rec = target.one(req)
+            except Exception as e:  # noqa: BLE001 — a failed request is a counter, not a crash
+                kind = "shed" if _is_shed(e) else "errors"
+                with lock:
+                    counters[kind] += 1
+                    if tenant is not None:
+                        tcounters[tenant][kind] += 1
                 print(f"[loadgen] request failed: {e}", file=sys.stderr)
                 continue
             with lock:
@@ -145,8 +225,17 @@ def run_loadgen(
                     counters["deadline_exceeded"] += 1
                 else:
                     counters["errors"] += 1
+                if tenant is not None:
+                    key = {"done": "done",
+                           "deadline_exceeded": "deadline_exceeded"}.get(
+                               status, "errors")
+                    tcounters[tenant][key] += 1
             reservoirs["loadgen_request"].add(rec["_e2e_s"], rec["_e2e_s"])
             reservoirs["loadgen_submit"].add(rec["_submit_s"], rec["_submit_s"])
+            if tenant is not None:
+                reservoirs[f"loadgen_request_{tenant}"].add(
+                    rec["_e2e_s"], rec["_e2e_s"]
+                )
 
     t0 = time.perf_counter()
     threads = [threading.Thread(target=worker, daemon=True)
@@ -175,6 +264,21 @@ def run_loadgen(
         "throughput_rps": round(counters["done"] / wall_s, 4) if wall_s else None,
         "latency": summaries.get("loadgen_request"),
     }
+    if tenant_names:
+        per_tenant = {}
+        for t in tenant_names:
+            c = tcounters[t]
+            lat = summaries.get(f"loadgen_request_{t}") or {}
+            attempted = max(c["requests"], 1)
+            per_tenant[t] = {
+                **c,
+                "shed_rate": round(c["shed"] / attempted, 4),
+                "success_rate": round(
+                    c["done"] / max(c["requests"] - c["shed"], 1), 4),
+                "p50_s": lat.get("blocked_p50_s"),
+                "p99_s": lat.get("blocked_p99_s"),
+            }
+        record["tenants"] = per_tenant
     extra_events = []
     if collect_extra is not None:
         try:
@@ -196,22 +300,44 @@ def run_loadgen(
             ev = dict(e)
             led.event(ev.pop("event", "fault"), **ev)
         led.event("loadgen_summary", **{k: v for k, v in record.items()
-                                        if k != "latency"})
+                                        if k not in ("latency", "tenants")})
         led.close()  # flushes execute_timing events
         record["ledger"] = ledger_path
     return record
+
+
+def _parse_replica_faults(specs: List[str]) -> Dict[int, str]:
+    out: Dict[int, str] = {}
+    for spec in specs or []:
+        idx, sep, plan = str(spec).partition(":")
+        if not sep or not plan:
+            raise ValueError(
+                f"bad --replica_faults {spec!r} — expected IDX:PLAN "
+                "(e.g. 0:unavail@1-999)"
+            )
+        out[int(idx)] = plan
+    return out
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     target_group = ap.add_mutually_exclusive_group(required=True)
     target_group.add_argument("--url", type=str,
-                              help="base URL of a running cli/serve.py engine")
+                              help="base URL of a running cli/serve.py engine "
+                                   "or cli/router.py fleet")
     target_group.add_argument("--inproc", action="store_true",
                               help="build an in-process engine (tiny/"
                                    "random-init smoke mode)")
+    target_group.add_argument("--router", type=int, default=None,
+                              metavar="N",
+                              help="build an in-process FLEET: N engine "
+                                   "replicas sharing one disk inversion "
+                                   "store behind a real HTTP router, and "
+                                   "drive the router URL")
     ap.add_argument("--requests", type=int, default=16)
-    ap.add_argument("--concurrency", type=int, default=4)
+    ap.add_argument("--concurrency", type=int, default=4,
+                    help="closed-loop clients (one blocked thread each — "
+                         "thousands fit one driver)")
     ap.add_argument("--timeout_s", type=float, default=600.0)
     ap.add_argument("--image", type=str, default="data/rabbit")
     ap.add_argument("--prompt", type=str, default="a rabbit is jumping")
@@ -221,38 +347,62 @@ def main(argv=None) -> int:
                     help="vary the request seed per issue index so every "
                          "request MISSES the inversion store (cold-path "
                          "load) instead of hitting after the first")
+    ap.add_argument("--tenants", type=str, default=None,
+                    help="per-tenant workload mix, 'A:5,B:1' weight syntax: "
+                         "requests carry tenant names on a deterministic "
+                         "weighted cycle; the summary/ledger grow per-tenant "
+                         "p50/p99 + shed rates. Also passed as the engine's "
+                         "QoS config in --inproc/--router modes")
     ap.add_argument("--ledger", type=str, default="loadgen_ledger.jsonl")
-    # in-process engine knobs (smoke mode)
+    # in-process engine knobs (smoke + fleet modes)
     ap.add_argument("--tiny", action="store_true", default=None)
     ap.add_argument("--steps", type=int, default=4)
     ap.add_argument("--video_len", type=int, default=2)
     ap.add_argument("--width", type=int, default=512)
     ap.add_argument("--checkpoint", type=str, default=None)
     ap.add_argument("--max_batch", type=int, default=4)
-    # chaos mode (ISSUE 9): deterministic fault injection + resilience knobs
+    ap.add_argument("--scheduler", type=str, default="drain",
+                    choices=["drain", "continuous", "fair"],
+                    help="batching policy for the in-process engine(s) "
+                         "(serve/sched.py)")
+    ap.add_argument("--out_dir", type=str, default="loadgen_out")
+    ap.add_argument("--inv_store", type=str, default=None,
+                    help="fleet mode: the shared disk inversion-store root "
+                         "(default <out_dir>/inv_store)")
+    # chaos mode (ISSUEs 9 + 11): deterministic fault injection
     ap.add_argument("--faults", type=str, default=None,
                     help="fault plan (serve/faults.py DSL: fail@K, "
                          "hang@K:S, unavail@A-B, corrupt:PAT) injected into "
                          "the --inproc engine; the engine's fault/breaker "
                          "events and serve_health summary land in the "
                          "loadgen ledger")
+    ap.add_argument("--replica_faults", action="append", default=[],
+                    metavar="IDX:PLAN",
+                    help="fleet chaos (--router): inject a fault plan into "
+                         "replica IDX only (repeatable) — the router must "
+                         "shed to the healthy replicas; gate with "
+                         "--min_success_rate")
     ap.add_argument("--min_success_rate", type=float, default=None,
                     help="exit 1 when done/(requests-shed) falls below "
                          "this; default 0.5 in chaos mode, else the legacy "
                          "errors!=0 rule")
     ap.add_argument("--deadline_s", type=float, default=None,
-                    help="default per-request deadline for the --inproc "
-                         "engine")
+                    help="default per-request deadline for the in-process "
+                         "engine(s)")
     ap.add_argument("--dispatch_timeout_s", type=float, default=None)
     ap.add_argument("--max_retries", type=int, default=2)
     ap.add_argument("--breaker_threshold", type=int, default=3)
     ap.add_argument("--breaker_open_s", type=float, default=1.0)
     ap.add_argument("--max_queue", type=int, default=64)
     args = ap.parse_args(argv)
-    if args.faults and args.url:
+    if args.faults and not args.inproc:
         ap.error("--faults injects at the engine seams — use --inproc "
-                 "(a remote engine takes VIDEOP2P_SERVE_FAULTS / "
+                 "(fleet chaos: --router N --replica_faults IDX:PLAN; a "
+                 "remote engine takes VIDEOP2P_SERVE_FAULTS / "
                  "cli/serve.py --faults instead)")
+    if args.replica_faults and not args.router:
+        ap.error("--replica_faults needs --router N (per-replica fleet "
+                 "chaos)")
 
     request = {
         "image_path": args.image,
@@ -260,8 +410,26 @@ def main(argv=None) -> int:
         "prompts": [args.prompt, args.edit_prompt],
         "save_name": "loadgen",
     }
+    tenant_weights = parse_tenant_weights(args.tenants)
     engine = None
+    supervisor = None
+    router_server = None
     collect_extra = None
+    chaos = bool(args.faults or args.replica_faults)
+
+    def engine_kwargs():
+        return dict(
+            max_batch=args.max_batch,
+            max_queue=args.max_queue,
+            default_deadline_s=args.deadline_s,
+            dispatch_timeout_s=args.dispatch_timeout_s,
+            max_retries=args.max_retries,
+            breaker_threshold=args.breaker_threshold,
+            breaker_open_s=args.breaker_open_s,
+            scheduler=args.scheduler,
+            tenants=args.tenants,
+        )
+
     if args.url:
         target = _HttpTarget(args.url, args.timeout_s)
         meta = {"target": args.url}
@@ -289,6 +457,50 @@ def main(argv=None) -> int:
             if trips is not None:
                 health["breaker_trips"] = trips
             return [health]
+    elif args.router:
+        from videop2p_tpu.cli.common import enable_compile_cache
+        from videop2p_tpu.serve import (
+            ProgramSpec,
+            ReplicaSupervisor,
+            Router,
+            RouterServer,
+        )
+
+        enable_compile_cache()
+        tiny = True if args.tiny is None else args.tiny
+        spec = ProgramSpec(checkpoint=args.checkpoint, tiny=tiny,
+                           steps=args.steps, video_len=args.video_len,
+                           width=args.width)
+        supervisor = ReplicaSupervisor(
+            spec, args.router, out_dir=args.out_dir,
+            persist_dir=args.inv_store,
+            warm_prompts=(args.prompt, args.edit_prompt),
+            warm_kwargs=dict(batch_sizes=(min(2, args.max_batch),)),
+            engine_kwargs=engine_kwargs(),
+            faults=_parse_replica_faults(args.replica_faults),
+        )
+        print(f"[loadgen] starting {args.router}-replica fleet "
+              f"(shared store: {supervisor.persist_dir})...")
+        supervisor.start()
+        router = Router(supervisor.urls, probe_ttl_s=0.1)
+        router_server = RouterServer(router).start()
+        target = _HttpTarget(router_server.url, args.timeout_s)
+        meta = {"target": f"router[{args.router}]", "tiny": tiny,
+                "steps": args.steps, "scheduler": args.scheduler,
+                "replica_faults": list(args.replica_faults)}
+
+        def collect_extra(record, supervisor=supervisor, router=router):
+            # the fleet's reliability trail: every replica's fault/breaker
+            # events + serve_health (labelled), plus the router's summary —
+            # one ledger gates latency AND fleet reliability
+            events = []
+            for r in supervisor.replicas:
+                events += [dict(e) for e in r.engine.fault_log]
+                events.append({"event": "serve_health", "label": r.name,
+                               **r.engine.health_record()})
+            record["router"] = router.health_record()
+            events.append({"event": "router_health", **record["router"]})
+            return events
     else:
         from videop2p_tpu.cli.common import enable_compile_cache
         from videop2p_tpu.serve import EditEngine, FaultPlan, ProgramSpec
@@ -300,20 +512,15 @@ def main(argv=None) -> int:
             ProgramSpec(checkpoint=args.checkpoint, tiny=tiny,
                         steps=args.steps, video_len=args.video_len,
                         width=args.width),
-            out_dir="loadgen_out", max_batch=args.max_batch,
-            max_queue=args.max_queue,
-            default_deadline_s=args.deadline_s,
-            dispatch_timeout_s=args.dispatch_timeout_s,
-            max_retries=args.max_retries,
-            breaker_threshold=args.breaker_threshold,
-            breaker_open_s=args.breaker_open_s,
+            out_dir=args.out_dir,
             faults=faults,
+            **engine_kwargs(),
         )
         engine.warm((args.prompt, args.edit_prompt),
                     batch_sizes=(min(2, args.max_batch),))
         target = _InprocTarget(engine, args.timeout_s)
         meta = {"target": "inproc", "tiny": tiny, "steps": args.steps,
-                "faults": args.faults}
+                "scheduler": args.scheduler, "faults": args.faults}
 
         def collect_extra(record, engine=engine):
             # the engine's own fault/breaker trail + reliability summary —
@@ -323,19 +530,11 @@ def main(argv=None) -> int:
                 {"event": "serve_health", **engine.health_record()}
             ]
 
+    mutate_request = None
     if args.distinct_seeds:
-        # closed-loop cold traffic: unique seed per request index
-        issue_lock = threading.Lock()
-        counter = {"n": 0}
-        base_one = target.one
-
-        def one_with_seed(req):
-            with issue_lock:
-                counter["n"] += 1
-                req = dict(req, seed=counter["n"])
-            return base_one(req)
-
-        target.one = one_with_seed
+        # closed-loop cold traffic: unique seed per request issue index
+        def mutate_request(req, idx):
+            return dict(req, seed=idx + 1)
 
     try:
         record = run_loadgen(
@@ -343,13 +542,19 @@ def main(argv=None) -> int:
             requests=args.requests, concurrency=args.concurrency,
             ledger_path=args.ledger, meta=meta,
             collect_extra=collect_extra,
+            tenants=tenant_weights or None,
+            mutate_request=mutate_request,
         )
     finally:
+        if router_server is not None:
+            router_server.close()
+        if supervisor is not None:
+            supervisor.stop()
         if engine is not None:
             engine.close()
     print(json.dumps(record, default=str))
     min_rate = args.min_success_rate
-    if min_rate is None and args.faults:
+    if min_rate is None and chaos:
         min_rate = 0.5  # chaos default: doomed requests expected, most survive
     if min_rate is not None:
         ok = record["success_rate"] >= min_rate

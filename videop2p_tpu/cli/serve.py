@@ -62,6 +62,34 @@ def build_parser() -> argparse.ArgumentParser:
                     choices=["scan", "vmap"],
                     help="scan: one dispatch, per-request math bit-exact vs "
                          "singleton; vmap: vectorized + data-mesh sharded")
+    # scheduling policy + per-tenant QoS (ISSUE 11 — serve/sched.py,
+    # docs/SERVING.md "Fleet")
+    ap.add_argument("--scheduler", type=str, default="drain",
+                    choices=["drain", "continuous", "fair"],
+                    help="batching policy: drain = classic plan-boundary "
+                         "windows (pre-scheduler behavior, bit-exact); "
+                         "continuous = iteration-level admission (new "
+                         "compatible requests join the NEXT dispatch, "
+                         "deadline-aware ordering); fair = per-tenant "
+                         "priority lanes + deficit-round-robin QoS")
+    ap.add_argument("--tenants", type=str, default=None,
+                    help="per-tenant QoS config: 'name:weight[:priority]' "
+                         "pairs (e.g. 'A:5,B:1') or a JSON object with "
+                         "weight/priority/deadline_s per tenant; requests "
+                         "pick their lane via the 'tenant' field")
+    ap.add_argument("--max_batch_wait_ms", type=float, default=None,
+                    help="cap any request's total batch-formation wait "
+                         "(drain: bounds the admit window by the first "
+                         "request's time-in-queue; continuous: the partial-"
+                         "batch fill hold). Default: unbounded (bit-exact "
+                         "drain baseline)")
+    ap.add_argument("--batch_order", type=str, default="first_seen",
+                    choices=["first_seen", "oldest"],
+                    help="drain-policy dispatch order of planned chunks: "
+                         "first_seen (pre-scheduler behavior) or oldest "
+                         "(by each chunk's oldest member — an early rare-"
+                         "key singleton no longer delays the dominant "
+                         "key's batch)")
     ap.add_argument("--ledger", type=str, default=None,
                     help="serve ledger path (default <out_dir>/serve_ledger"
                          ".jsonl) — live /metrics reads its reservoirs")
@@ -137,6 +165,11 @@ def main(argv=None) -> int:
         max_batch=args.max_batch,
         max_wait_s=args.max_wait_ms / 1000.0,
         batch_dispatch=args.batch_dispatch,
+        scheduler=args.scheduler,
+        tenants=args.tenants,
+        max_batch_wait_s=(args.max_batch_wait_ms / 1000.0
+                          if args.max_batch_wait_ms is not None else None),
+        batch_order=args.batch_order,
         ledger_path=args.ledger,
         max_queue=args.max_queue,
         default_deadline_s=args.deadline_s,

@@ -312,6 +312,29 @@ def extract_run(events: Sequence[Dict[str, Any]],
                 if k not in ("event", "t", "label")
                 and isinstance(v, (int, float)) and not isinstance(v, bool)
             }
+            # per-tenant QoS sub-records (ISSUE 11) flatten into their own
+            # reliability labels so FAULT_RULES gate each tenant's
+            # error/shed rates exactly like the fleet's
+            tenants = e.get("tenants")
+            if isinstance(tenants, dict):
+                for tname, tvals in tenants.items():
+                    if not isinstance(tvals, dict):
+                        continue
+                    rec["reliability"][f"{label}:tenant:{tname}"] = {
+                        k: float(v) for k, v in tvals.items()
+                        if isinstance(v, (int, float))
+                        and not isinstance(v, bool)
+                    }
+        elif kind == "router_health":
+            # the fleet router's summary (ISSUE 11) joins the reliability
+            # section under its own label — shared labels across two
+            # router runs get the same declarative gates
+            label = e.get("label") or "router"
+            rec["reliability"][label] = {
+                k: float(v) for k, v in e.items()
+                if k not in ("event", "t", "label")
+                and isinstance(v, (int, float)) and not isinstance(v, bool)
+            }
         elif kind == "device_telemetry":
             # the in-scan probe's worst divergence joins the same gate
             label = e.get("program") or "(unattributed)"

@@ -23,6 +23,18 @@ DDIM inversions per edit of the same clip. This package keeps both warm:
   * :mod:`videop2p_tpu.serve.http` / :mod:`videop2p_tpu.serve.client` —
     the stdlib JSON API (``cli/serve.py`` is the entry point) and its
     urllib client (the UI's engine-backed path; ``tools/serve_loadgen.py``).
+  * :mod:`videop2p_tpu.serve.sched` — pluggable request schedulers
+    (ISSUE 11): ``drain`` (the pre-scheduler engine, pinned bit-exact),
+    ``continuous`` (iteration-level admission into the next dispatch),
+    ``fair`` (per-tenant priority lanes + deficit-round-robin QoS with
+    :class:`TenantConfig` deadline budgets).
+  * :mod:`videop2p_tpu.serve.replica` / :mod:`videop2p_tpu.serve.router`
+    — the fleet tier: a :class:`ReplicaSupervisor` running N engines over
+    ONE shared content-addressed disk inversion store (an inversion on
+    replica A is a disk store-hit on replica B), and a stdlib
+    :class:`Router` that load-balances on ``/healthz``/``/metrics``,
+    routes around open circuit breakers, retries deterministically and
+    aggregates fleet health (``cli/router.py`` is the entry point).
   * :mod:`videop2p_tpu.serve.faults` — the resilience layer's primitives
     (ISSUE 9): deterministic fault injection (:class:`FaultPlan`), the
     jitter-free :class:`RetryPolicy`, the :class:`CircuitBreaker`, and the
@@ -53,6 +65,18 @@ from videop2p_tpu.serve.faults import (
     is_transient,
 )
 from videop2p_tpu.serve.programs import ProgramCache, ProgramSet, ProgramSpec
+from videop2p_tpu.serve.replica import Replica, ReplicaSupervisor
+from videop2p_tpu.serve.router import Router, RouterServer, make_router_server
+from videop2p_tpu.serve.sched import (
+    SCHEDULER_POLICIES,
+    ContinuousScheduler,
+    DrainScheduler,
+    FairScheduler,
+    Scheduler,
+    TenantConfig,
+    make_scheduler,
+    parse_tenants,
+)
 from videop2p_tpu.serve.store import (
     InversionStore,
     load_persisted_inversion,
@@ -84,4 +108,17 @@ __all__ = [
     "InversionStore",
     "load_persisted_inversion",
     "save_persisted_inversion",
+    "SCHEDULER_POLICIES",
+    "Scheduler",
+    "DrainScheduler",
+    "ContinuousScheduler",
+    "FairScheduler",
+    "TenantConfig",
+    "make_scheduler",
+    "parse_tenants",
+    "Replica",
+    "ReplicaSupervisor",
+    "Router",
+    "RouterServer",
+    "make_router_server",
 ]

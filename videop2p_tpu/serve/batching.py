@@ -35,7 +35,7 @@ from __future__ import annotations
 
 import hashlib
 from dataclasses import dataclass
-from typing import Any, Callable, Dict, List, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 
@@ -98,32 +98,52 @@ def plan_batches(
     max_batch: int = 4,
     key_fn: Callable[[Any], str] = lambda item: item.compat,
     pad: bool = True,
+    order: str = "first_seen",
+    arrival_fn: Optional[Callable[[Any], Any]] = None,
 ) -> List[Batch]:
     """Group ``items`` by compatibility key into dispatch batches.
 
     Deterministic: groups form in first-seen-key order, items keep their
     submit order inside a group, groups split into chunks of at most
-    ``max_batch``, and each chunk pads to its bucket size. No reordering
-    across keys beyond the grouping itself — a pure function of
-    (items, max_batch).
+    ``max_batch``, and each chunk pads to its bucket size. A pure function
+    of (items, max_batch, order).
+
+    ``order`` picks the DISPATCH order of the planned chunks:
+
+      * ``"first_seen"`` (default, pinned bit-exact vs the pre-scheduler
+        engine) — chunks dispatch in first-seen-key order, so every chunk
+        of an early rare key precedes a later dominant key's batch;
+      * ``"oldest"`` — chunks dispatch by the arrival of their OLDEST
+        member (``arrival_fn`` per item; defaults to position in
+        ``items``), stable-sorted, so a batch full of early requests is
+        never stuck behind a singleton that merely arrived first in its
+        key group.
     """
     if max_batch < 1:
         raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+    if order not in ("first_seen", "oldest"):
+        raise ValueError(
+            f"order must be 'first_seen' or 'oldest', got {order!r}"
+        )
+    arrivals = {id(item): (arrival_fn(item) if arrival_fn is not None else i)
+                for i, item in enumerate(items)}
     groups: "Dict[str, List[Any]]" = {}
-    order: List[str] = []
+    seen: List[str] = []
     for item in items:
         k = key_fn(item)
         if k not in groups:
             groups[k] = []
-            order.append(k)
+            seen.append(k)
         groups[k].append(item)
     batches: List[Batch] = []
-    for k in order:
+    for k in seen:
         group = groups[k]
         for start in range(0, len(group), max_batch):
             chunk = group[start:start + max_batch]
             size = bucket_size(len(chunk), max_batch) if pad else len(chunk)
             batches.append(Batch(key=k, items=chunk, padded_size=size))
+    if order == "oldest":
+        batches.sort(key=lambda b: min(arrivals[id(i)] for i in b.items))
     return batches
 
 

@@ -9,10 +9,14 @@ program order is deterministic and the HTTP layer never touches devices):
   trajectory through its warm inversion program, no frame IO / VAE encode
   / cold compile — and only then runs VAE encode + capture-inversion ONCE
   per clip) → batch (compatible concurrent requests group into one
-  dispatch, :mod:`videop2p_tpu.serve.batching`) → dispatch (the warm
-  ``serve_edit`` program: cached-source controlled edit + VAE decode)
-  → artifacts (GIFs) + per-request verdicts (``src_err``, compile-event
-  delta, store hit).
+  dispatch, :mod:`videop2p_tpu.serve.batching`, formed by the PLUGGABLE
+  scheduling policy — :mod:`videop2p_tpu.serve.sched`: ``drain`` is the
+  bit-exact plan-boundary baseline, ``continuous`` admits mid-flight
+  requests into the next dispatch, ``fair`` runs per-tenant
+  deficit-round-robin lanes) → dispatch (the warm ``serve_edit`` program:
+  cached-source controlled edit + VAE decode) → artifacts (GIFs) +
+  per-request verdicts (``src_err``, compile-event delta, store hit,
+  ``queue_wait_s``).
 
 Resilience layer (ISSUE 9 — see ``docs/SERVING.md`` "Failure semantics"):
 
@@ -66,9 +70,14 @@ import numpy as np
 
 from videop2p_tpu.serve.batching import (
     compat_key,
-    plan_batches,
     stack_items,
     unstack_outputs,
+)
+from videop2p_tpu.serve.sched import (
+    Scheduler,
+    TenantConfig,
+    make_scheduler,
+    parse_tenants,
 )
 from videop2p_tpu.serve.faults import (
     CircuitBreaker,
@@ -87,7 +96,7 @@ __all__ = ["EditRequest", "EditEngine", "TERMINAL_STATUSES"]
 _REQUEST_FIELDS = (
     "image_path", "prompt", "prompts", "save_name", "is_word_swap",
     "blend_word", "eq_params", "cross_replace_steps", "self_replace_steps",
-    "seed", "steps", "deadline_s",
+    "seed", "steps", "deadline_s", "tenant",
 )
 
 # the machine-readable terminal statuses — everything else is in flight.
@@ -130,6 +139,10 @@ class EditRequest:
     # expires (queued, resolving or mid-dispatch — the dispatch watchdog
     # bounds the block-until-ready). None = the engine default.
     deadline_s: Optional[float] = None
+    # QoS identity: the fair scheduler's lane, the per-tenant deadline
+    # default (TenantConfig), and the per-tenant accounting in
+    # serve_health / /metrics all key on this; "" → "default"
+    tenant: str = "default"
     frames: Optional[np.ndarray] = None
 
     @classmethod
@@ -163,17 +176,25 @@ class EditRequest:
             raise ValueError(
                 f"'deadline_s' must be positive seconds, got {self.deadline_s!r}"
             )
+        if self.tenant is not None and not isinstance(self.tenant, str):
+            raise ValueError(f"'tenant' must be a string, got {self.tenant!r}")
 
 
-@dataclass
+@dataclass(eq=False)
 class _Prepared:
     """A resolved request, ready to batch: the device argument tree plus
-    its batching-compatibility key and resolved step count."""
+    its batching-compatibility key, resolved step count, and the
+    scheduling metadata the pluggable policies order on (submit sequence,
+    arrival clock, deadline, tenant lane)."""
 
     rid: str
     args: Tuple  # (cached, cond_all, uncond, ctx, anchor)
     compat: str
     steps: int
+    seq: int = 0
+    arrival_s: float = 0.0
+    deadline_at: Optional[float] = None
+    tenant: str = "default"
 
 
 class EditEngine:
@@ -192,6 +213,20 @@ class EditEngine:
         ledger_path: Optional[str] = None,
         keep_videos: bool = False,
         programs: Optional[ProgramSet] = None,
+        # scheduling policy (ISSUE 11 — serve/sched.py): "drain" is the
+        # pre-scheduler engine pinned bit-exact; "continuous" admits
+        # compatible requests into the next dispatch; "fair" runs
+        # per-tenant DRR lanes. Also accepts a Scheduler instance.
+        scheduler: Any = "drain",
+        # per-tenant QoS config: {name: TenantConfig} or the CLI spec
+        # string ("A:5,B:1" / JSON) — weights/priorities for the fair
+        # policy plus per-tenant default deadline budgets
+        tenants: Any = None,
+        # drain-policy latency knobs (defaults keep it bit-exact): cap the
+        # admit window by the first request's total time-in-queue, and
+        # dispatch planned chunks by oldest-member arrival
+        max_batch_wait_s: Optional[float] = None,
+        batch_order: str = "first_seen",
         # resilience knobs (docs/SERVING.md "Failure semantics")
         max_queue: int = 64,
         default_deadline_s: Optional[float] = None,
@@ -220,10 +255,24 @@ class EditEngine:
                                       open_s=breaker_open_s,
                                       on_transition=self._on_breaker)
         self.faults = faults if faults is not None else FaultPlan.from_env()
+        self.tenants: Dict[str, TenantConfig] = (
+            parse_tenants(tenants) if isinstance(tenants, str)
+            else dict(tenants or {})
+        )
+        if isinstance(scheduler, Scheduler):
+            self.scheduler = scheduler
+        else:
+            self.scheduler = make_scheduler(
+                str(scheduler or "drain"),
+                max_batch=self.max_batch, max_wait_s=self.max_wait_s,
+                max_batch_wait_s=max_batch_wait_s, order=batch_order,
+                tenants=self.tenants,
+            )
         self.ledger = make_run_ledger(
             ledger_path or os.path.join(out_dir, "serve_ledger.jsonl"),
             enable=True, latency=True, set_latency_env=False,
             meta={"cli": "serve", "spec": dict(spec.resolved().__dict__),
+                  "scheduler": self.scheduler.name,
                   "faults": getattr(self.faults, "spec", None)},
             mesh=spec.mesh,
         )
@@ -232,14 +281,23 @@ class EditEngine:
             "shed": 0, "rejected_unavailable": 0, "retries": 0,
             "faults_injected": 0, "rehydrations": 0, "fresh_inversions": 0,
         }
+        # per-tenant QoS accounting (serve_health "tenants" / /metrics)
+        self.tenant_counters: Dict[str, Dict[str, int]] = {}
         self._counter_lock = threading.Lock()
+        self._seq = 0
+        self._qw_sum = 0.0
+        self._qw_count = 0
         if self.faults is not None:
             self.faults.on_inject = self._fault_event
         self.programs = programs if programs is not None else ProgramSet(spec)
         self.spec = self.programs.spec
         # per-request `steps` is admitted only against this set — unknown
-        # step geometry is a 400 at submit, never a cold compile mid-serve
+        # step geometry is a 400 at submit, never a cold compile mid-serve.
+        # A shared (already-warm) ProgramSet — replicas in one process —
+        # hands its warmed buckets straight to this engine.
         self.warm_steps = {self.spec.steps}
+        if self.programs.warmed:
+            self.warm_steps.update(self.programs.warmed.get("steps", []))
         self.store = InversionStore(store_budget_bytes, persist_dir=persist_dir,
                                     faults=self.faults)
         self._spec_fp = self.spec.fingerprint()
@@ -288,10 +346,12 @@ class EditEngine:
         outside the warmed buckets raises ``ValueError`` (400) listing the
         warm list — unknown step geometry must not silently compile cold
         mid-serve."""
+        tenant = request.tenant or "default"
         if self._closed:
             raise EngineUnavailable("engine is closed")
         if not self.breaker.allow():
             self._count("rejected_unavailable")
+            self._tcount(tenant, "rejected")
             raise EngineUnavailable(
                 f"circuit breaker open after "
                 f"{self.breaker.consecutive_failures} consecutive dispatch "
@@ -309,8 +369,14 @@ class EditEngine:
             )
         rid = uuid.uuid4().hex[:12]
         now = time.perf_counter()
-        deadline_s = (request.deadline_s if request.deadline_s is not None
-                      else self.default_deadline_s)
+        # deadline budget resolution: the request's own > the tenant's
+        # TenantConfig default > the engine default
+        deadline_s = request.deadline_s
+        if deadline_s is None:
+            tcfg = self.tenants.get(tenant)
+            deadline_s = tcfg.deadline_s if tcfg is not None else None
+        if deadline_s is None:
+            deadline_s = self.default_deadline_s
         rec = {
             "id": rid,
             "status": "queued",
@@ -318,6 +384,7 @@ class EditEngine:
             "deadline_s": deadline_s,
             "deadline_at": (now + float(deadline_s)
                             if deadline_s is not None else None),
+            "tenant": tenant,
             "request": {k: v for k, v in request.to_dict().items()
                         if k != "frames"},
             "compile_events_before": len(self.ledger.compile_seconds),
@@ -327,11 +394,15 @@ class EditEngine:
                 depth = self._inflight
             else:
                 depth = None
+                self._seq += 1
+                rec["seq"] = self._seq
                 self._requests[rid] = rec
                 self._inflight += 1
         if depth is not None:
             self._count("shed")
+            self._tcount(tenant, "shed")
             raise QueueFull(depth, self.max_queue)
+        self._tcount(tenant, "submitted")
         self._queue.put((rid, request))
         return rid
 
@@ -381,6 +452,8 @@ class EditEngine:
             "queue_depth": self._queue.qsize(),
             "in_flight": in_flight,
             "max_queue": self.max_queue,
+            "scheduler": self.scheduler.snapshot(),
+            "tenants": self._tenant_records(),
             "breaker": self.breaker.snapshot(),
             "counters": dict(self.counters),
             "store": self.store.stats(),
@@ -393,11 +466,34 @@ class EditEngine:
             "devices": self._device_memory(),
         }
 
+    def _tenant_records(self) -> Dict[str, Dict[str, Any]]:
+        """Per-tenant QoS accounting (``SERVE_TENANT_FIELDS``): terminal
+        outcomes plus error/shed rates per tenant lane."""
+        with self._counter_lock:
+            counters = {t: dict(c) for t, c in self.tenant_counters.items()}
+        out: Dict[str, Dict[str, Any]] = {}
+        for t, c in counters.items():
+            done = c.get("done", 0)
+            errors = c.get("errors", 0)
+            deadline_exceeded = c.get("deadline_exceeded", 0)
+            finished = (done + errors + deadline_exceeded
+                        + c.get("engine_closed", 0))
+            attempts = c.get("submitted", 0) + c.get("shed", 0) + c.get("rejected", 0)
+            out[t] = {
+                **c,
+                "error_rate": (round((errors + deadline_exceeded) / finished, 4)
+                               if finished else 0.0),
+                "shed_rate": (round((c.get("shed", 0) + c.get("rejected", 0))
+                                    / attempts, 4) if attempts else 0.0),
+            }
+        return out
+
     def health_record(self) -> Dict[str, Any]:
         """The ``serve_health`` reliability summary (obs/history.py's
         ``reliability`` section; gated by ``FAULT_RULES``): request
-        outcomes by terminal status, error/shed rates, breaker trips and
-        the injection/recovery counters."""
+        outcomes by terminal status, error/shed rates, breaker trips,
+        the injection/recovery counters, the scheduling policy with its
+        mean queue wait, and the per-tenant QoS sub-records."""
         with self._req_lock:
             by_status: Dict[str, int] = {}
             for rec in self._requests.values():
@@ -428,6 +524,10 @@ class EditEngine:
             "rehydrations": self.counters["rehydrations"],
             "fresh_inversions": self.counters["fresh_inversions"],
             "store_corrupt": self.store.disk_corrupt,
+            "scheduler": self.scheduler.name,
+            "queue_wait_mean_s": (round(self._qw_sum / self._qw_count, 4)
+                                  if self._qw_count else 0.0),
+            "tenants": self._tenant_records(),
         }
 
     def close(self, *, drain_s: float = 0.0) -> None:
@@ -479,6 +579,17 @@ class EditEngine:
         with self._counter_lock:
             self.counters[name] = self.counters.get(name, 0) + n
 
+    _TENANT_COUNTER_KEYS = ("submitted", "done", "errors",
+                            "deadline_exceeded", "engine_closed", "shed",
+                            "rejected")
+
+    def _tcount(self, tenant: str, name: str, n: int = 1) -> None:
+        with self._counter_lock:
+            d = self.tenant_counters.setdefault(
+                tenant, {k: 0 for k in self._TENANT_COUNTER_KEYS}
+            )
+            d[name] = d.get(name, 0) + n
+
     def _fault_event(self, kind: str, **fields: Any) -> None:
         """One fault observation (injected via the FaultPlan's on_inject
         callback, or engine-classified): ledger ``fault`` event + the
@@ -506,51 +617,88 @@ class EditEngine:
     # ---- worker ----------------------------------------------------------
 
     def _worker_loop(self) -> None:
+        """The scheduling loop (ISSUE 11): the pluggable policy picks the
+        admit window (``collect``), the worker resolves what it pulled,
+        and the policy forms dispatch batches (``next_plan``). Preemptive
+        policies (continuous, fair) return to ``collect`` after EVERY
+        dispatch — that is iteration-level admission: a compatible request
+        arriving mid-dispatch joins the next batch. The drain policy keeps
+        the classic plan boundary (every planned batch dispatches before
+        the next window opens) and is pinned bit-exact vs the
+        pre-scheduler engine."""
+        sched = self.scheduler
         while True:
-            batch = self._collect()
-            if batch is None:
+            raw = sched.collect(self)
+            if raw is None:
                 break
-            if not batch:
-                continue
             prepared = []
-            for rid, request in batch:
+            for rid, request in raw:
                 p = self._resolve(rid, request)
                 if p is not None:
                     prepared.append(p)
-            for plan in plan_batches(prepared, max_batch=self.max_batch):
+            if prepared:
+                sched.add(prepared)
+            while True:
+                plan = sched.next_plan(time.perf_counter(),
+                                       queue_empty=self._queue.empty())
+                if plan is None:
+                    break
                 try:
                     self._dispatch(plan)
                 except Exception as e:  # noqa: BLE001 — the worker must outlive ANY batch
                     for p in plan.items:
                         self._fail(p.rid, f"dispatch failed unexpectedly: {e}",
                                    time.perf_counter())
+                if sched.preemptive:
+                    break
         self._done.set()
 
-    def _collect(self):
-        """One admit window: block for the first request, then keep
-        draining compatible-or-not requests until ``max_batch`` are in
-        hand or ``max_wait_s`` elapses (grouping happens after resolve —
-        an incompatible request simply lands in its own batch). A closed
-        engine past its drain window stops collecting — close() fails
-        whatever is left."""
+    def _collect_window(self, max_items: int, window_s: float, *,
+                        first_timeout_s: float = 0.2,
+                        oldest_budget_s: Optional[float] = None,
+                        greedy: bool = False):
+        """One admit window (the schedulers parameterize it): block up to
+        ``first_timeout_s`` for the first request, then keep draining
+        compatible-or-not requests until ``max_items`` are in hand or
+        ``window_s`` elapses (grouping happens after resolve — an
+        incompatible request simply lands in its own batch).
+        ``oldest_budget_s`` additionally caps the window by the FIRST
+        request's total time-in-queue since submit (the drain policy's
+        ``max_batch_wait_s`` knob); ``greedy`` keeps taking
+        already-queued requests after the window closes without blocking
+        (the continuous/fair policies' instant drain). A closed engine
+        past its drain window stops collecting — close() fails whatever
+        is left."""
         if self._closed and time.perf_counter() >= self._drain_until:
             return None
         try:
-            first = self._queue.get(timeout=0.2)
+            first = self._queue.get(timeout=first_timeout_s)
         except queue.Empty:
             return []
         if first is None:
             return None
         items = [first]
-        deadline = time.perf_counter() + self.max_wait_s
-        while len(items) < self.max_batch:
+        deadline = time.perf_counter() + window_s
+        if oldest_budget_s is not None:
+            with self._req_lock:
+                rec = self._requests.get(first[0])
+                submitted = rec.get("submitted_s") if rec else None
+            if submitted is not None:
+                deadline = min(deadline, submitted + float(oldest_budget_s))
+        while len(items) < max_items:
             remaining = deadline - time.perf_counter()
             if remaining <= 0:
-                break
-            try:
-                nxt = self._queue.get(timeout=max(remaining, 0.0))
-            except queue.Empty:
-                break
+                if not greedy:
+                    break
+                try:
+                    nxt = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+            else:
+                try:
+                    nxt = self._queue.get(timeout=remaining)
+                except queue.Empty:
+                    break
             if nxt is None:
                 self._queue.put(None)  # re-post the sentinel for the outer loop
                 break
@@ -610,7 +758,23 @@ class EditEngine:
             self._fail_status(rid, "deadline_exceeded",
                               "deadline expired before resolve")
             return None
-        self._update(rid, status="resolving")
+        with self._req_lock:
+            rec0 = self._requests.get(rid) or {}
+            submitted = rec0.get("submitted_s")
+            seq = rec0.get("seq", 0)
+            deadline_at = rec0.get("deadline_at")
+            tenant = rec0.get("tenant", "default")
+        # queue wait: submit → the worker picking the request up. The
+        # continuous-vs-drain acceptance compares this reservoir's mean
+        # across scheduling policies on the same trace.
+        queue_wait_s = max(t0 - submitted, 0.0) if submitted else 0.0
+        self.ledger.record_execute("serve_queue_wait", queue_wait_s,
+                                   queue_wait_s)
+        with self._counter_lock:
+            self._qw_sum += queue_wait_s
+            self._qw_count += 1
+        self._update(rid, status="resolving",
+                     queue_wait_s=round(queue_wait_s, 4))
         try:
             ps = self.programs
             steps = int(request.steps) if request.steps else self.spec.steps
@@ -699,6 +863,8 @@ class EditEngine:
                     self._spec_fp, steps, self.spec.guidance_scale,
                     self.batch_dispatch,
                 )),
+                seq=seq, arrival_s=t0, deadline_at=deadline_at,
+                tenant=tenant,
             )
         except Exception as e:  # noqa: BLE001 — one bad request must not kill the engine
             self._fail(rid, f"resolve failed: {e}", t0)
@@ -876,7 +1042,11 @@ class EditEngine:
             rec["status"] = status
             rec.update(fields)
             self._inflight -= 1
-            return True
+            tenant = rec.get("tenant", "default")
+        self._tcount(tenant, {"done": "done", "error": "errors",
+                              "deadline_exceeded": "deadline_exceeded",
+                              "engine_closed": "engine_closed"}[status])
+        return True
 
     def _fail_status(self, rid: str, status: str, message: str,
                      t0: Optional[float] = None) -> None:

@@ -59,6 +59,7 @@ __all__ = [
     "FAULT_EVENT_FIELDS",
     "BREAKER_EVENT_FIELDS",
     "SERVE_HEALTH_FIELDS",
+    "SERVE_TENANT_FIELDS",
 ]
 
 FAULTS_ENV = "VIDEOP2P_SERVE_FAULTS"
@@ -74,7 +75,16 @@ SERVE_HEALTH_FIELDS = (
     "requests", "done", "errors", "deadline_exceeded", "engine_closed",
     "shed", "rejected_unavailable", "error_rate", "shed_rate",
     "breaker_trips", "retries", "faults_injected", "rehydrations",
-    "fresh_inversions", "store_corrupt",
+    "fresh_inversions", "store_corrupt", "queue_wait_mean_s",
+)
+
+# per-tenant QoS sub-records (ISSUE 11): the `serve_health` event's
+# "tenants" map carries one of these per tenant lane — obs/history.py
+# flattens each into its own reliability label ("serve:tenant:<name>") so
+# FAULT_RULES gate per-tenant error/shed rates exactly like the fleet's.
+SERVE_TENANT_FIELDS = (
+    "submitted", "done", "errors", "deadline_exceeded", "engine_closed",
+    "shed", "rejected", "error_rate", "shed_rate",
 )
 
 

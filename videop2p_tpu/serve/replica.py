@@ -1,0 +1,254 @@
+"""Replica supervision: N edit engines sharing one disk inversion store.
+
+The fleet tier (ISSUE 11) runs multiple :class:`~videop2p_tpu.serve.
+engine.EditEngine` replicas behind one :class:`~videop2p_tpu.serve.router.
+Router`. Replicas share NOTHING in memory — what makes them a fleet is the
+content-addressed DISK inversion store root (``serve/store.py``
+write-through + ``load_disk`` rehydration): a clip inverted on replica A
+persists its trajectory under the shared root, so the same request landing
+on replica B is a disk store-hit — B rebuilds bit-identical capture
+products through its warm inversion program (``src_err == 0.0``, zero new
+compile events, no frame IO), never a second inversion.
+
+Two run modes:
+
+  * ``"inproc"`` — N engines + their HTTP servers inside THIS process
+    (the CPU test / loadgen mode). Engines share one warm
+    :class:`~videop2p_tpu.serve.programs.ProgramSet` by default
+    (``share_programs=True``): the programs compile once and every
+    replica dispatches through them — single-host replication amortizes
+    compiles exactly like requests amortize inversions. Per-replica
+    :class:`~videop2p_tpu.serve.faults.FaultPlan` injection makes the
+    router's shed-to-healthy-replica behavior testable on CPU.
+  * ``"subprocess"`` — one ``python -m videop2p_tpu.cli.serve`` process
+    per replica on its own port (real isolation; each process compiles
+    its own programs). The supervisor waits for every ``/healthz`` to
+    answer before reporting the fleet up, and stops replicas with
+    SIGTERM so they take their graceful drain window.
+
+Stdlib+numpy+jax only — the import-guard test walks this package.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+__all__ = ["Replica", "ReplicaSupervisor", "free_port"]
+
+
+def free_port(host: str = "127.0.0.1") -> int:
+    """An OS-assigned free TCP port (subprocess replicas need concrete
+    ports before the child can bind)."""
+    with socket.socket() as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+@dataclass
+class Replica:
+    """One running engine replica: its name, URL and (mode-dependent)
+    in-process handles or child process."""
+
+    name: str
+    url: str
+    engine: Any = None          # EditEngine (inproc mode)
+    server: Any = None          # EditServer (inproc mode)
+    proc: Any = None            # subprocess.Popen (subprocess mode)
+    meta: Dict[str, Any] = field(default_factory=dict)
+
+
+class ReplicaSupervisor:
+    """Start/stop N engine replicas over one shared inversion-store root.
+
+    ``faults`` maps replica INDEX → :class:`FaultPlan` (or DSL string) so
+    a chaos run can take exactly one replica through an unavailable
+    window while the rest stay healthy — the router must shed to them.
+    """
+
+    def __init__(
+        self,
+        spec: Any,
+        replicas: int = 2,
+        *,
+        out_dir: str,
+        persist_dir: Optional[str] = None,
+        mode: str = "inproc",
+        host: str = "127.0.0.1",
+        share_programs: bool = True,
+        programs: Any = None,
+        engine_kwargs: Optional[Dict[str, Any]] = None,
+        warm_prompts: Any = ("a video", "an edited video"),
+        warm_kwargs: Optional[Dict[str, Any]] = None,
+        faults: Optional[Dict[int, Any]] = None,
+        serve_argv: Optional[List[str]] = None,
+        startup_timeout_s: float = 600.0,
+    ):
+        if mode not in ("inproc", "subprocess"):
+            raise ValueError(
+                f"mode must be 'inproc' or 'subprocess', got {mode!r}"
+            )
+        if replicas < 1:
+            raise ValueError(f"need >= 1 replica, got {replicas}")
+        self.spec = spec
+        self.n = int(replicas)
+        self.mode = mode
+        self.host = host
+        self.out_dir = out_dir
+        # the shared content-addressed disk root IS the fleet's state
+        self.persist_dir = persist_dir or os.path.join(out_dir, "inv_store")
+        self.share_programs = bool(share_programs)
+        # a pre-built (possibly already-warm) ProgramSet to share across
+        # inproc replicas instead of building a fresh one
+        self.programs = programs
+        self.engine_kwargs = dict(engine_kwargs or {})
+        self.warm_prompts = tuple(warm_prompts)
+        self.warm_kwargs = dict(warm_kwargs or {})
+        self.faults = dict(faults or {})
+        self.serve_argv = list(serve_argv or [])
+        self.startup_timeout_s = float(startup_timeout_s)
+        self.replicas: List[Replica] = []
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def start(self) -> List[Replica]:
+        if self.replicas:
+            return self.replicas
+        os.makedirs(self.persist_dir, exist_ok=True)
+        if self.mode == "inproc":
+            self._start_inproc()
+        else:
+            self._start_subprocess()
+        return self.replicas
+
+    def stop(self) -> None:
+        for r in self.replicas:
+            if r.server is not None:
+                try:
+                    r.server.close()
+                except Exception:  # noqa: BLE001 — teardown is best-effort
+                    pass
+            if r.engine is not None:
+                try:
+                    r.engine.close()
+                except Exception:  # noqa: BLE001
+                    pass
+            if r.proc is not None:
+                try:
+                    r.proc.terminate()  # SIGTERM → the CLI's graceful drain
+                except Exception:  # noqa: BLE001
+                    pass
+        for r in self.replicas:
+            if r.proc is not None:
+                try:
+                    r.proc.wait(timeout=30.0)
+                except Exception:  # noqa: BLE001
+                    r.proc.kill()
+        self.replicas = []
+
+    def __enter__(self) -> "ReplicaSupervisor":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    @property
+    def urls(self) -> List[str]:
+        return [r.url for r in self.replicas]
+
+    # ---- inproc mode -----------------------------------------------------
+
+    def _start_inproc(self) -> None:
+        from videop2p_tpu.serve.engine import EditEngine
+        from videop2p_tpu.serve.faults import FaultPlan
+        from videop2p_tpu.serve.http import make_server
+        from videop2p_tpu.serve.programs import ProgramSet
+
+        shared = self.programs
+        if shared is None and self.share_programs:
+            shared = ProgramSet(self.spec)
+        for i in range(self.n):
+            name = f"replica{i}"
+            plan = self.faults.get(i)
+            if isinstance(plan, str):
+                plan = FaultPlan.parse(plan)
+            engine = EditEngine(
+                self.spec,
+                out_dir=os.path.join(self.out_dir, name),
+                persist_dir=self.persist_dir,
+                programs=shared,
+                faults=plan,
+                **self.engine_kwargs,
+            )
+            if i == 0 or not self.share_programs:
+                # first replica warms the (shared) programs; the rest
+                # adopt the warm bucket list at construction
+                engine.warm(self.warm_prompts, **self.warm_kwargs)
+            else:
+                engine.warm_steps.update(
+                    (shared.warmed or {}).get("steps", [])
+                )
+            server = make_server(engine, host=self.host).start()
+            self.replicas.append(Replica(
+                name=name, url=server.url, engine=engine, server=server,
+                meta={"faults": getattr(plan, "spec", None)},
+            ))
+
+    # ---- subprocess mode -------------------------------------------------
+
+    def _spec_argv(self) -> List[str]:
+        spec = self.spec
+        argv = ["--width", str(spec.width), "--video_len", str(spec.video_len),
+                "--steps", str(spec.steps), "--seed", str(spec.seed)]
+        if spec.checkpoint:
+            argv += ["--checkpoint", spec.checkpoint]
+        if spec.tiny:
+            argv += ["--tiny"]
+        return argv
+
+    def _start_subprocess(self) -> None:
+        procs = []
+        for i in range(self.n):
+            name = f"replica{i}"
+            port = free_port(self.host)
+            out = os.path.join(self.out_dir, name)
+            os.makedirs(out, exist_ok=True)
+            argv = [sys.executable, "-m", "videop2p_tpu.cli.serve",
+                    "--host", self.host, "--port", str(port),
+                    "--out_dir", out, "--inv_store", self.persist_dir]
+            argv += self._spec_argv() + self.serve_argv
+            plan = self.faults.get(i)
+            if plan is not None:
+                argv += ["--faults",
+                         plan if isinstance(plan, str) else plan.spec]
+            log = open(os.path.join(out, "serve.log"), "ab")
+            proc = subprocess.Popen(argv, stdout=log, stderr=log)
+            url = f"http://{self.host}:{port}"
+            procs.append(Replica(name=name, url=url, proc=proc))
+        deadline = time.perf_counter() + self.startup_timeout_s
+        from videop2p_tpu.serve.client import engine_available
+
+        for r in procs:
+            while not engine_available(r.url, timeout_s=2.0):
+                if r.proc.poll() is not None:
+                    self.replicas = procs
+                    self.stop()
+                    raise RuntimeError(
+                        f"{r.name} exited with rc={r.proc.returncode} before "
+                        f"answering /healthz (see {self.out_dir}/{r.name}/serve.log)"
+                    )
+                if time.perf_counter() > deadline:
+                    self.replicas = procs
+                    self.stop()
+                    raise TimeoutError(
+                        f"{r.name} did not answer /healthz within "
+                        f"{self.startup_timeout_s:.0f}s"
+                    )
+                time.sleep(0.5)
+        self.replicas = procs

@@ -1,0 +1,482 @@
+"""Multi-replica router: one HTTP front door over an engine fleet.
+
+The router (ISSUE 11) is the fleet's load balancer, built on the
+machine-readable surfaces the replicas already expose:
+
+  * **placement** — candidates rank by the ``/healthz`` serving status
+    first (``ok`` before ``degraded`` — an open circuit breaker routes
+    AROUND, not to), then by live load (``/metrics`` ``queue_depth`` +
+    ``in_flight`` gauges), then by the ``/metrics`` reservoir blocked-p99
+    (two idle replicas tie-break toward the historically faster one).
+    Health/metrics probes are cached for ``probe_ttl_s`` so routing adds
+    one cheap dict lookup per request, not two RTTs.
+  * **failure handling** — a submit that fast-fails (connection refused,
+    429 load shed, 503 breaker-open) marks the replica SUSPECT for
+    ``suspend_s`` and falls through to the next candidate in the same
+    pass; when every replica refuses, the router retries the whole pass
+    on the deterministic :class:`~videop2p_tpu.serve.faults.RetryPolicy`
+    before answering 503 itself. Client errors (400/404) never retry —
+    they would fail identically everywhere.
+  * **affinity** — ``/v1/edits/<id>`` polls route to the replica that
+    accepted the id (the router keeps the id → replica map); results,
+    artifacts and ledgers stay replica-local. What is FLEET-global is the
+    content-addressed disk inversion store the replicas share: an
+    inversion created on replica A is a disk store-hit on replica B
+    (``serve/replica.py``), so affinity is a routing convenience, not a
+    correctness requirement.
+  * **aggregation** — the router's ``/healthz`` and ``/metrics`` merge
+    every replica's record under ``replicas`` plus a fleet summary, and
+    ``close()`` writes one ``router_health`` ledger event
+    (:data:`ROUTER_HEALTH_FIELDS`, gated through ``tools/obs_diff.py``
+    like ``serve_health``).
+
+Stdlib only — the import-guard test walks this package.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from videop2p_tpu.serve.client import EngineClient
+from videop2p_tpu.serve.faults import EngineUnavailable, RetryPolicy
+
+__all__ = ["Router", "RouterServer", "make_router_server",
+           "ROUTER_HEALTH_FIELDS"]
+
+# ledger-event schema pin (tests/test_bench_guard.py): the `router_health`
+# summary's numeric fields — obs/history.py extracts them into the
+# reliability section (label "router") so FAULT_RULES-style gates apply.
+ROUTER_HEALTH_FIELDS = (
+    "replicas", "healthy", "submitted", "routed", "retries",
+    "routed_around", "rejected", "proxy_errors",
+)
+
+
+class _ReplicaView:
+    """The router's view of one replica: a fail-fast client plus cached
+    health/metrics probes and the suspect window."""
+
+    def __init__(self, name: str, url: str, *, timeout_s: float):
+        self.name = name
+        self.url = url.rstrip("/")
+        # retries=0: the ROUTER owns retry/failover policy, the per-call
+        # client must fail fast so a sick replica costs one RTT, not a
+        # client-side backoff schedule
+        self.client = EngineClient(url, timeout_s=timeout_s, retries=0)
+        self.suspended_until = 0.0
+        self.consecutive_failures = 0
+        self.routed = 0
+        self._probe: Optional[Tuple[float, Dict[str, Any], Dict[str, Any]]] = None
+        self._lock = threading.Lock()
+
+    def probe(self, ttl_s: float) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        """(healthz, metrics) — cached up to ``ttl_s``; an unreachable
+        replica probes as ``{"ok": False}`` rather than raising."""
+        now = time.perf_counter()
+        with self._lock:
+            if self._probe is not None and now - self._probe[0] < ttl_s:
+                return self._probe[1], self._probe[2]
+        try:
+            health = self.client.healthz()
+        except Exception as e:  # noqa: BLE001 — unreachable is a ranking fact
+            health = {"ok": False, "status": "unreachable", "error": str(e)}
+        metrics: Dict[str, Any] = {}
+        if health.get("ok"):
+            try:
+                metrics = self.client.metrics()
+            except Exception:  # noqa: BLE001
+                metrics = {}
+        with self._lock:
+            self._probe = (time.perf_counter(), health, metrics)
+        return health, metrics
+
+    def invalidate(self) -> None:
+        with self._lock:
+            self._probe = None
+
+    def suspend(self, seconds: float) -> None:
+        self.suspended_until = time.perf_counter() + max(float(seconds), 0.0)
+        self.consecutive_failures += 1
+        self.invalidate()
+
+    @property
+    def suspended(self) -> bool:
+        return time.perf_counter() < self.suspended_until
+
+
+class RouterBadRequest(ValueError):
+    """A replica answered 4xx — the request itself is wrong; never
+    retried or failed over (it would fail identically everywhere)."""
+
+
+class Router:
+    """Load-balance edit requests over replica URLs (module docstring)."""
+
+    def __init__(
+        self,
+        replica_urls: Sequence[str],
+        *,
+        timeout_s: float = 30.0,
+        max_retries: int = 2,
+        retry_base_s: float = 0.05,
+        retry_cap_s: float = 1.0,
+        suspend_s: float = 1.0,
+        probe_ttl_s: float = 0.5,
+        ledger: Any = None,
+        ledger_path: Optional[str] = None,
+    ):
+        urls = [str(u) for u in replica_urls if str(u).strip()]
+        if not urls:
+            raise ValueError("router needs at least one replica URL")
+        self.views = [_ReplicaView(f"replica{i}", u, timeout_s=timeout_s)
+                      for i, u in enumerate(urls)]
+        self.retry = RetryPolicy(max_retries=max_retries, base_s=retry_base_s,
+                                 cap_s=retry_cap_s)
+        self.suspend_s = float(suspend_s)
+        self.probe_ttl_s = float(probe_ttl_s)
+        self.ledger = ledger
+        if ledger is None and ledger_path:
+            from videop2p_tpu.obs import RunLedger
+
+            self.ledger = RunLedger(
+                ledger_path,
+                meta={"cli": "router", "replicas": urls},
+            )
+        self._rid_map: Dict[str, _ReplicaView] = {}
+        self._lock = threading.Lock()
+        self.counters: Dict[str, int] = {
+            "submitted": 0, "routed": 0, "retries": 0, "routed_around": 0,
+            "rejected": 0, "proxy_errors": 0,
+        }
+        self.started = time.perf_counter()
+        self._closed = False
+
+    # ---- placement -------------------------------------------------------
+
+    def _count(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + n
+
+    def rank(self) -> Tuple[List[_ReplicaView], List[_ReplicaView]]:
+        """``(candidates, avoided)`` — candidates ordered best-first by
+        (healthy, load, p99, index); ``avoided`` is every replica skipped
+        for being suspect, unreachable or breaker-degraded (they remain
+        LAST-RESORT candidates so a fully-degraded fleet still routes
+        rather than rejecting everything)."""
+        scored = []
+        avoided = []
+        for i, v in enumerate(self.views):
+            health, metrics = v.probe(self.probe_ttl_s)
+            healthy = bool(health.get("ok")) and health.get("status") == "ok"
+            bad = (not healthy) or v.suspended
+            if bad:
+                avoided.append(v)
+            load = 0
+            p99 = 0.0
+            if metrics:
+                load = int(metrics.get("queue_depth") or 0) + int(
+                    metrics.get("in_flight") or 0
+                )
+                lat = metrics.get("request_latency") or {}
+                p99 = float(lat.get("blocked_p99_s") or 0.0)
+            scored.append((1 if bad else 0, load, p99, i, v))
+        scored.sort(key=lambda t: t[:4])
+        return [t[4] for t in scored], avoided
+
+    # ---- request surface -------------------------------------------------
+
+    def submit(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        """Route one submit; returns ``{"id", "replica"}``. Raises
+        :class:`RouterBadRequest` on a 4xx answer (the caller's fault) and
+        :class:`EngineUnavailable` when no replica accepts after the
+        deterministic retry schedule."""
+        self._count("submitted")
+        attempt = 0
+        last_error = "no replicas"
+        while True:
+            candidates, avoided = self.rank()
+            avoided_ids = {id(v) for v in avoided}
+            for view in candidates:
+                try:
+                    rid = view.client.submit(dict(body))
+                except RuntimeError as e:
+                    msg = str(e)
+                    if "HTTP 400" in msg or "HTTP 404" in msg:
+                        raise RouterBadRequest(msg) from e
+                    # shed (429) / breaker-open (503) / unreachable: mark
+                    # suspect and fall through to the next candidate
+                    view.suspend(self.suspend_s)
+                    last_error = f"{view.name}: {msg}"
+                    continue
+                except Exception as e:  # noqa: BLE001 — network-level failure
+                    view.suspend(self.suspend_s)
+                    last_error = f"{view.name}: {type(e).__name__}: {e}"
+                    continue
+                with self._lock:
+                    self._rid_map[rid] = view
+                    self.counters["routed"] += 1
+                    if avoided_ids and id(view) not in avoided_ids:
+                        # an unhealthy replica was routed AROUND
+                        self.counters["routed_around"] += 1
+                view.routed += 1
+                view.consecutive_failures = 0
+                if self.ledger is not None:
+                    self.ledger.record_execute("router_submit", 0.0, 0.0)
+                return {"id": rid, "replica": view.name}
+            if attempt >= self.retry.max_retries:
+                break
+            delay = self.retry.delay_s(attempt)
+            self._count("retries")
+            attempt += 1
+            time.sleep(delay)
+        self._count("rejected")
+        raise EngineUnavailable(
+            f"no replica accepted the request after {attempt + 1} pass(es) "
+            f"(last: {last_error})",
+            retry_after_s=self.suspend_s,
+        )
+
+    def _view_for(self, rid: str) -> _ReplicaView:
+        with self._lock:
+            view = self._rid_map.get(rid)
+        if view is None:
+            raise KeyError(f"unknown request id {rid!r} (not routed here)")
+        return view
+
+    def poll(self, rid: str) -> Dict[str, Any]:
+        view = self._view_for(rid)
+        try:
+            rec = view.client.poll(rid)
+        except RuntimeError as e:
+            if "HTTP 404" in str(e):
+                raise KeyError(str(e)) from e
+            self._count("proxy_errors")
+            raise
+        rec["replica"] = view.name
+        return rec
+
+    def result(self, rid: str, *, wait_s: float = 0.0) -> Dict[str, Any]:
+        view = self._view_for(rid)
+        try:
+            rec = view.client.result(rid, wait_s=wait_s)
+        except RuntimeError as e:
+            if "HTTP 404" in str(e):
+                raise KeyError(str(e)) from e
+            self._count("proxy_errors")
+            raise
+        rec["replica"] = view.name
+        return rec
+
+    # ---- fleet aggregation ----------------------------------------------
+
+    def healthz(self) -> Dict[str, Any]:
+        """Fleet liveness: ok when ANY replica serves; per-replica
+        statuses attached. Load balancers in front of the router key on
+        ``ok``; dashboards read the per-replica map."""
+        per = {}
+        healthy = 0
+        for v in self.views:
+            health, _ = v.probe(self.probe_ttl_s)
+            ok = bool(health.get("ok")) and health.get("status") == "ok"
+            healthy += int(ok)
+            per[v.name] = {
+                "url": v.url,
+                "ok": bool(health.get("ok")),
+                "status": health.get("status"),
+                "suspended": v.suspended,
+                "breaker": health.get("breaker"),
+                "warm": health.get("warm"),
+            }
+        return {
+            "ok": healthy > 0,
+            "status": "ok" if healthy == len(self.views) else (
+                "degraded" if healthy else "unavailable"),
+            "replicas": per,
+            "healthy": healthy,
+            "total": len(self.views),
+        }
+
+    def metrics(self) -> Dict[str, Any]:
+        """Fleet metrics: the router's own counters plus every replica's
+        live ``/metrics`` record under its name."""
+        per = {}
+        fleet_requests: Dict[str, int] = {}
+        for v in self.views:
+            _, metrics = v.probe(self.probe_ttl_s)
+            per[v.name] = {"url": v.url, "routed": v.routed, **metrics}
+            for status, n in (metrics.get("requests") or {}).items():
+                fleet_requests[status] = fleet_requests.get(status, 0) + int(n)
+        return {
+            "uptime_s": round(time.perf_counter() - self.started, 3),
+            "router": dict(self.counters),
+            "requests": fleet_requests,
+            "replicas": per,
+        }
+
+    def health_record(self) -> Dict[str, Any]:
+        """The ``router_health`` summary (:data:`ROUTER_HEALTH_FIELDS`
+        plus the per-replica routed map)."""
+        health = self.healthz()
+        with self._lock:
+            counters = dict(self.counters)
+        return {
+            "replicas": health["total"],
+            "healthy": health["healthy"],
+            "submitted": counters["submitted"],
+            "routed": counters["routed"],
+            "retries": counters["retries"],
+            "routed_around": counters["routed_around"],
+            "rejected": counters["rejected"],
+            "proxy_errors": counters["proxy_errors"],
+            "per_replica": {v.name: v.routed for v in self.views},
+        }
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self.ledger is not None:
+            self.ledger.event("router_health", **self.health_record())
+            self.ledger.close()
+
+    def __enter__(self) -> "Router":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+# ---- HTTP front door -----------------------------------------------------
+
+_EDIT_PATH = re.compile(r"^/v1/edits/([0-9a-f]+)(/result)?$")
+
+
+def _make_handler(router: Router):
+    from http.server import BaseHTTPRequestHandler
+    from urllib.parse import parse_qs, urlparse
+
+    class _Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # quiet; the ledger records
+            pass
+
+        def _send(self, code: int, payload: Dict[str, Any],
+                  headers: Optional[Dict[str, str]] = None) -> None:
+            body = json.dumps(payload, default=str).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _error(self, code: int, message: str, *,
+                   headers: Optional[Dict[str, str]] = None,
+                   **extra: Any) -> None:
+            self._send(code, {"error": message, **extra}, headers=headers)
+
+        def do_GET(self) -> None:  # noqa: N802 — handler contract
+            url = urlparse(self.path)
+            try:
+                if url.path == "/healthz":
+                    self._send(200, router.healthz())
+                    return
+                if url.path == "/metrics":
+                    self._send(200, router.metrics())
+                    return
+                m = _EDIT_PATH.match(url.path)
+                if m:
+                    rid, want_result = m.group(1), bool(m.group(2))
+                    if want_result:
+                        wait_s = float(
+                            parse_qs(url.query).get("wait_s", ["0"])[0]
+                        )
+                        self._send(200, router.result(rid, wait_s=wait_s))
+                    else:
+                        self._send(200, router.poll(rid))
+                    return
+                self._error(404, f"no route for {url.path}")
+            except KeyError as e:
+                self._error(404, str(e))
+            except Exception as e:  # noqa: BLE001 — a handler crash must not kill the router
+                self._error(500, f"{type(e).__name__}: {e}")
+
+        def do_POST(self) -> None:  # noqa: N802
+            url = urlparse(self.path)
+            try:
+                if url.path != "/v1/edits":
+                    self._error(404, f"no route for {url.path}")
+                    return
+                length = int(self.headers.get("Content-Length", "0"))
+                try:
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                    out = router.submit(body)
+                except RouterBadRequest as e:
+                    self._error(400, str(e))
+                    return
+                except EngineUnavailable as e:
+                    headers = {}
+                    if e.retry_after_s is not None:
+                        headers["Retry-After"] = str(
+                            max(int(e.retry_after_s + 0.999), 1)
+                        )
+                    self._error(503, str(e), headers=headers,
+                                retry_after_s=e.retry_after_s)
+                    return
+                except (ValueError, TypeError) as e:
+                    self._error(400, str(e))
+                    return
+                self._send(202, out)
+            except Exception as e:  # noqa: BLE001
+                self._error(500, f"{type(e).__name__}: {e}")
+
+    return _Handler
+
+
+class RouterServer:
+    """A ThreadingHTTPServer bound to one :class:`Router` — same surface
+    as the replica servers, so every client (loadgen, UI, EngineClient)
+    talks to a fleet exactly like it talks to one engine."""
+
+    def __init__(self, router: Router, host: str = "127.0.0.1",
+                 port: int = 0):
+        from http.server import ThreadingHTTPServer
+
+        self.router = router
+        self.httpd = ThreadingHTTPServer((host, port), _make_handler(router))
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        host, port = self.httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "RouterServer":
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="router-http", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        self.httpd.serve_forever()
+
+    def close(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+        self.router.close()
+
+
+def make_router_server(replica_urls: Sequence[str], *,
+                       host: str = "127.0.0.1", port: int = 0,
+                       **router_kwargs) -> RouterServer:
+    return RouterServer(Router(replica_urls, **router_kwargs),
+                        host=host, port=port)
