@@ -464,6 +464,14 @@ def test_report_and_obs_import_only_stdlib_numpy_jax():
             "http.py", "client.py", "faults.py", "sched.py", "replica.py",
             "router.py"} <= set(serve_files)
     files += [os.path.join(serve_dir, f) for f in serve_files]
+    # ISSUE 12 pin: the streaming tier (window plan, resumable manifest,
+    # job driver) joins the guarded set — resume/chaos machinery must run
+    # anywhere the engine does, so it stays stdlib+numpy+jax
+    stream_dir = os.path.join(_REPO, "videop2p_tpu", "stream")
+    stream_files = sorted(f for f in os.listdir(stream_dir)
+                          if f.endswith(".py"))
+    assert {"windows.py", "manifest.py", "driver.py"} <= set(stream_files)
+    files += [os.path.join(stream_dir, f) for f in stream_files]
     offenders = []
     for path in files:
         roots = _import_roots(path)
@@ -715,6 +723,111 @@ def test_router_and_tenant_ledger_event_schema(tmp_path):
 
     assert set(EditEngine._TENANT_COUNTER_KEYS) | {"error_rate", "shed_rate"} \
         == set(SERVE_TENANT_FIELDS)
+
+
+def test_stream_health_ledger_event_schema_and_seam_rules(tmp_path):
+    """Schema pin (ISSUE 12): the ``stream_health`` summary carries its
+    documented field set, SEAM_RULES ride in DEFAULT_RULES (kind
+    "stream"), obs/history.py extracts the event into the `stream`
+    section — and the gate semantics hold: identical runs self-compare
+    clean, a seam-PSNR drop / a new passthrough / a nonzero src_err_max
+    regress with obs_diff exit-1 teeth."""
+    from videop2p_tpu.obs import RunLedger, read_ledger
+    from videop2p_tpu.obs.history import (
+        DEFAULT_RULES,
+        SEAM_RULES,
+        evaluate_rules,
+        extract_run,
+        split_runs,
+    )
+    from videop2p_tpu.stream.driver import (
+        STREAM_HEALTH_FIELDS,
+        STREAM_SEAM_FIELDS,
+        STREAM_WINDOW_FIELDS,
+    )
+
+    assert all(r in DEFAULT_RULES for r in SEAM_RULES)
+    assert all(r.kind == "stream" for r in SEAM_RULES)
+    assert {r.metric for r in SEAM_RULES} == {
+        "seam_min_psnr", "seam_mean_psnr", "windows_failed",
+        "windows_passthrough", "manifest_corrupt", "src_err_max"}
+
+    health = {k: 0 for k in STREAM_HEALTH_FIELDS}
+    health.update(windows_total=4, windows_done=4, seams=3,
+                  seam_min_psnr=24.0, seam_mean_psnr=30.0,
+                  source_seam_min_psnr=26.0, src_err_max=0.0)
+    path = str(tmp_path / "ledger.jsonl")
+    with RunLedger(path) as led:
+        led.event("stream_window", index=0, key="k", status="done",
+                  attempts=1, store_source="fresh", src_err=0.0,
+                  window_s=0.5)
+        led.event("stream_seam", left=0, right=1, start=3, stop=4,
+                  seam_psnr=24.0, source_psnr=26.0)
+        led.event("stream_health", **health)
+    by_kind = {e["event"]: e for e in read_ledger(path)}
+    assert set(STREAM_WINDOW_FIELDS) <= set(by_kind["stream_window"])
+    assert set(STREAM_SEAM_FIELDS) <= set(by_kind["stream_seam"])
+    assert set(STREAM_HEALTH_FIELDS) <= set(by_kind["stream_health"])
+    rec = extract_run(split_runs(read_ledger(path))[-1])
+    assert set(STREAM_HEALTH_FIELDS) <= set(rec["stream"]["stream"])
+    # pre-PR-12 ledgers extract an empty (but present) stream section
+    assert extract_run([{"event": "run_start"}])["stream"] == {}
+
+    # gate semantics: self-compare clean; seam drop / new passthrough /
+    # nonzero src_err_max regress
+    assert evaluate_rules(rec, rec, SEAM_RULES)["pass"]
+    worse = {**rec, "stream": {"stream": {
+        **rec["stream"]["stream"],
+        "seam_min_psnr": 12.0, "windows_passthrough": 1.0,
+    }}}
+    result = evaluate_rules(rec, worse, SEAM_RULES)
+    assert not result["pass"]
+    assert {v["metric"] for v in result["regressions"]} == {
+        "seam_min_psnr", "windows_passthrough"}
+    # src_err_max is an exactness invariant: nonzero fails SELF-compare
+    diverged = {**rec, "stream": {"stream": {
+        **rec["stream"]["stream"], "src_err_max": 1e-6,
+    }}}
+    assert not evaluate_rules(diverged, diverged, SEAM_RULES)["pass"]
+    # inf→inf (a single-window job with no seams) passes clean
+    no_seams = {**rec, "stream": {"stream": {
+        **rec["stream"]["stream"],
+        "seam_min_psnr": float("inf"), "seam_mean_psnr": float("inf"),
+    }}}
+    assert evaluate_rules(no_seams, no_seams, SEAM_RULES)["pass"]
+
+
+def test_streaming_window_record_schema(bench):
+    """Schema pin (ISSUE 12): `streaming_window_records` turns one
+    per-window analysis into the 128f/480f streaming evidence rows —
+    exact window counts from the REAL planner, linear flop/store
+    scaling, every record carrying exactly STREAMING_WINDOW_FIELDS."""
+    records = bench.streaming_window_records(
+        {"e2e_cached": {"flops": 2.0e13, "temp_bytes": 1}}
+    )
+    assert [r["total_frames"] for r in records] == [128, 480]
+    by_total = {r["total_frames"]: r for r in records}
+    # the planner's counts: stride 6 with the final window end-anchored
+    assert by_total[128]["windows"] == 21
+    assert by_total[480]["windows"] == 80
+    for r in records:
+        assert set(r) == set(bench.STREAMING_WINDOW_FIELDS), r
+        assert r["window"] == bench.BENCH_FRAMES
+        assert r["flops_per_window"] == 2.0e13
+        assert r["flops_total"] == 2.0e13 * r["windows"]
+        assert r["store_bytes_total"] == \
+            r["store_bytes_per_window"] * r["windows"]
+        assert r["frames_processed"] == r["windows"] * r["window"]
+        assert r["overlap_overhead"] == pytest.approx(
+            r["frames_processed"] / r["total_frames"] - 1.0, abs=1e-3)
+        # one fp32 trajectory of steps+1 latent stacks per window
+        assert r["store_bytes_per_window"] == \
+            (bench.BENCH_STEPS + 1) * r["window"] * 64 * 64 * 4 * 4
+    # an incomplete capture still records the static plan geometry
+    no_flops = bench.streaming_window_records({})
+    assert all(r["flops_per_window"] is None and r["flops_total"] is None
+               for r in no_flops)
+    assert [r["windows"] for r in no_flops] == [21, 80]
 
 
 def test_no_wall_clock_in_timed_regions():

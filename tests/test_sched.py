@@ -575,6 +575,88 @@ def test_router_chaos_sheds_to_healthy_replica(programs, tmp_path):
     assert obs_diff.main(["obs_diff.py", ledger_path, ledger_path]) == 0
 
 
+def test_router_wedged_replica_probe_timeout_routes_around(tmp_path):
+    """ISSUE 12 satellite: a WEDGED replica — one that accepts TCP
+    connections but never answers — must cost the router its short
+    probe timeout once and then be routed AROUND, not hang the router
+    thread for the full request timeout. Proxied polls against the wedge
+    are bounded the same way and mark it suspect."""
+    import json as _json
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from videop2p_tpu.serve.router import Router
+
+    class _Wedged(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            pass
+
+        def do_GET(self):  # noqa: N802 — accept, then never answer
+            time.sleep(60.0)
+
+        do_POST = do_GET  # noqa: N815
+
+    class _Healthy(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            pass
+
+        def _send(self, payload):
+            body = _json.dumps(payload).encode()
+            self.send_response(200 if self.command == "GET" else 202)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802
+            if self.path == "/healthz":
+                self._send({"ok": True, "status": "ok"})
+            else:
+                self._send({"queue_depth": 0, "in_flight": 0})
+
+        def do_POST(self):  # noqa: N802
+            self.rfile.read(int(self.headers.get("Content-Length", "0")))
+            self._send({"id": "feedfacefeed"})
+
+    wedged = ThreadingHTTPServer(("127.0.0.1", 0), _Wedged)
+    healthy = ThreadingHTTPServer(("127.0.0.1", 0), _Healthy)
+    threads = [threading.Thread(target=s.serve_forever, daemon=True)
+               for s in (wedged, healthy)]
+    for t in threads:
+        t.start()
+    urls = [f"http://127.0.0.1:{wedged.server_address[1]}",
+            f"http://127.0.0.1:{healthy.server_address[1]}"]
+    router = Router(urls, timeout_s=2.0, probe_timeout_s=0.4,
+                    probe_ttl_s=0.0, suspend_s=5.0, max_retries=0)
+    try:
+        t0 = time.perf_counter()
+        out = router.submit({"prompt": "a", "prompts": ["a", "b"],
+                             "image_path": "x"})
+        elapsed = time.perf_counter() - t0
+        # the healthy replica took it, and fast — the wedge cost one short
+        # probe, not the 60 s it would happily have absorbed
+        assert out["replica"] == "replica1"
+        assert elapsed < 10.0, f"router hung {elapsed:.1f}s behind the wedge"
+        assert router.counters["routed_around"] == 1
+        health = router.healthz()
+        assert health["replicas"]["replica0"]["status"] == "unreachable"
+        assert health["replicas"]["replica1"]["ok"]
+        # proxied poll against the wedge: bounded by the hard socket
+        # timeout, surfaces as a proxy error and suspends the replica
+        with router._lock:
+            router._rid_map["deadbeef0000"] = router.views[0]
+        t0 = time.perf_counter()
+        with pytest.raises(RuntimeError, match="unreachable while proxying"):
+            router.poll("deadbeef0000")
+        assert time.perf_counter() - t0 < 10.0
+        assert router.counters["proxy_errors"] == 1
+        assert router.views[0].suspended
+    finally:
+        wedged.shutdown()
+        healthy.shutdown()
+        wedged.server_close()
+        healthy.server_close()
+
+
 def test_loadgen_per_tenant_mix_and_stats(programs, tmp_path):
     """Loadgen satellite: the --tenants weighted mix assigns tenants
     deterministically, per-tenant p50/p99 + shed-rate land in the summary

@@ -158,6 +158,36 @@ def test_store_disk_layer_roundtrip(tmp_path):
     assert np.array_equal(got2, traj)
 
 
+def test_store_write_through_is_atomic_and_truncation_detected(tmp_path):
+    """ISSUE 12 satellite: the disk write-through leaves no temp files
+    behind (every entry file goes write-temp-then-os.replace, so a kill
+    mid-write can never publish a torn entry), and an entry that somehow
+    IS truncated on disk is detected by `load_disk` — counted as
+    `disk_corrupt`, reported as a miss, never served."""
+    root = str(tmp_path / "inv_store")
+    traj = np.arange(4 * 1 * 2 * 2 * 2, dtype=np.float32).reshape(4, 1, 2, 2, 2)
+    store = InversionStore(byte_budget=1 << 20, persist_dir=root)
+    store.put("kt", {"anchor": np.zeros(4, np.float32)}, trajectory=traj,
+              meta={"clip": "x"})
+    entry_dir = os.path.join(root, "inv_cache", "kt")
+    assert sorted(os.listdir(entry_dir)) == ["meta.json", "trajectory.npy"]
+    assert not [f for f in os.listdir(entry_dir) if ".tmp" in f]
+    # healthy read first
+    assert np.array_equal(store.load_disk("kt"), traj)
+    assert store.disk_corrupt == 0
+    # truncate the published file to half — the kill-mid-write artifact a
+    # pre-atomic layout could leave
+    traj_path = os.path.join(entry_dir, "trajectory.npy")
+    size = os.path.getsize(traj_path)
+    with open(traj_path, "r+b") as f:
+        f.truncate(size // 2)
+    assert store.load_disk("kt") is None
+    assert store.disk_corrupt == 1
+    # an absent entry stays a plain miss, not a corruption
+    assert store.load_disk("never-written") is None
+    assert store.disk_corrupt == 1
+
+
 # ------------------------------------------- ledger concurrent writers --
 
 

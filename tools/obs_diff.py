@@ -17,7 +17,11 @@ blocked p50/p99 regress by growing), mined device traces
 by growing, the compute/collective overlap fraction by DROPPING), and
 serving reliability (``serve_health`` events — serve/faults.py + the
 engine: error rate, load-shed rate, breaker trips and deadline expiries
-regress by appearing/growing, gated by ``FAULT_RULES``)
+regress by appearing/growing, gated by ``FAULT_RULES``), and streaming
+long-video jobs (``stream_health`` events — stream/driver.py: window-seam
+adjacent-frame PSNR regresses by DROPPING, window failures/passthroughs
+and manifest corruption by appearing, ``src_err_max`` must be exactly 0 —
+gated by ``SEAM_RULES``)
 between a baseline run and a new run, renders per-program tables,
 evaluates the declarative regression rules (obs/history.py DEFAULT_RULES;
 scale every threshold with ``--threshold-scale``), and:
@@ -266,6 +270,36 @@ def render_diff(base: Dict, new: Dict, result: Dict) -> str:
                 _table(rows, ["label", "requests", "error_rate", "sheds",
                               "shed_rate", "breaker_trips",
                               "deadline_exceeded", "retries"])]
+
+    # streaming section (stream_health events — stream/driver.py, ISSUE
+    # 12): absent/empty for pre-PR-12 ledgers and non-streaming runs
+    stream = sorted(set(base.get("stream") or {})
+                    | set(new.get("stream") or {}))
+    if stream:
+        rows = []
+        for label in stream:
+            b = (base.get("stream") or {}).get(label, {})
+            n = (new.get("stream") or {}).get(label, {})
+
+            def scell(metric, b=b, n=n):
+                bv, nv = b.get(metric), n.get(metric)
+                if bv is None and nv is None:
+                    return "-"
+                if bv is None or nv is None:
+                    return f"{_fmt(bv)} → {_fmt(nv)}"
+                if bv == nv:
+                    return _fmt(nv)
+                return f"{_fmt(bv)} → {_fmt(nv)}"
+
+            rows.append([label, scell("windows_total"), scell("windows_done"),
+                         scell("windows_passthrough"), scell("windows_failed"),
+                         scell("seam_min_psnr"), scell("seam_mean_psnr"),
+                         scell("src_err_max")])
+        out += ["", "streaming (stream_health — seam PSNR regresses by "
+                "dropping; src_err_max must be 0):",
+                _table(rows, ["label", "windows", "done", "passthrough",
+                              "failed", "seam_min", "seam_mean",
+                              "src_err_max"])]
 
     comp = sorted(set(base.get("compiles", {})) | set(new.get("compiles", {})))
     if comp:

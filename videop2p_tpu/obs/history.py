@@ -41,6 +41,7 @@ __all__ = [
     "COMM_RULES",
     "TIMING_RULES",
     "FAULT_RULES",
+    "SEAM_RULES",
     "split_runs",
     "extract_run",
     "evaluate_rules",
@@ -63,7 +64,8 @@ class RegressionRule:
     (per-device peak HBM from ``memory`` snapshots), ``"divergence"``
     (cross-replica divergence scalars), ``"reliability"`` (serving-health
     summaries from ``serve_health`` events — error/shed rates, breaker
-    trips). ``min_abs`` suppresses verdicts
+    trips), ``"stream"`` (streaming-job summaries from ``stream_health``
+    events — seam PSNRs, window failures). ``min_abs`` suppresses verdicts
     whose absolute delta is noise-sized (a 0.001 s phase doubling is not a
     regression). ``programs`` (labels for program/compile/dispatch kinds,
     phase names for phases) restricts the rule; None applies it everywhere.
@@ -155,6 +157,31 @@ FAULT_RULES: Tuple[RegressionRule, ...] = (
                    threshold_pct=0.0, min_abs=0.5),
 )
 
+# streaming-seam gates (ISSUE 12): the long-video tier's window
+# boundaries are a quality surface of their own — the `stream_health`
+# summary (stream/driver.py) lands the worst cross-boundary
+# adjacent-frame PSNR per job, and a seam getting visibly worse regresses
+# exactly like a reconstruction drop (percentage-of-dB with a 0.5 dB
+# noise floor; inf→inf — a static clip, or a single-window job with no
+# seams — passes clean). Window failures, passthrough degradations and
+# detected manifest corruption are any-new-incident rules like the
+# reliability counters; `src_err_max` is an exactness invariant — every
+# edited window's source stream must replay bit-exact through the store,
+# so ANY nonzero value regresses even against itself.
+SEAM_RULES: Tuple[RegressionRule, ...] = (
+    RegressionRule("seam_min_psnr", kind="stream", direction="decrease",
+                   threshold_pct=5.0, min_abs=0.5),
+    RegressionRule("seam_mean_psnr", kind="stream", direction="decrease",
+                   threshold_pct=5.0, min_abs=0.5),
+    RegressionRule("windows_failed", kind="stream", threshold_pct=0.0,
+                   min_abs=0.5),
+    RegressionRule("windows_passthrough", kind="stream", threshold_pct=0.0,
+                   min_abs=0.5),
+    RegressionRule("manifest_corrupt", kind="stream", threshold_pct=0.0,
+                   min_abs=0.5),
+    RegressionRule("src_err_max", kind="stream", direction="nonzero"),
+)
+
 DEFAULT_RULES: Tuple[RegressionRule, ...] = (
     RegressionRule("flops", threshold_pct=10.0),
     RegressionRule("bytes_accessed", threshold_pct=15.0, min_abs=1 << 20),
@@ -163,7 +190,7 @@ DEFAULT_RULES: Tuple[RegressionRule, ...] = (
     RegressionRule("hlo_instructions", threshold_pct=25.0, min_abs=16),
     RegressionRule("seconds", kind="compile", threshold_pct=50.0, min_abs=1.0),
     RegressionRule("seconds", kind="phase", threshold_pct=25.0, min_abs=0.5),
-) + QUALITY_RULES + COMM_RULES + TIMING_RULES + FAULT_RULES
+) + QUALITY_RULES + COMM_RULES + TIMING_RULES + FAULT_RULES + SEAM_RULES
 
 
 def split_runs(events: Iterable[Dict[str, Any]]) -> List[List[Dict[str, Any]]]:
@@ -213,6 +240,8 @@ def extract_run(events: Sequence[Dict[str, Any]],
         "trace": {},
         # reliability section (ISSUE 9) — likewise empty pre-PR-9
         "reliability": {},
+        # streaming section (ISSUE 12) — likewise empty pre-PR-12
+        "stream": {},
     }
     for e in events:
         kind = e.get("event")
@@ -325,6 +354,15 @@ def extract_run(events: Sequence[Dict[str, Any]],
                         if isinstance(v, (int, float))
                         and not isinstance(v, bool)
                     }
+        elif kind == "stream_health":
+            # one summary per streaming job (ISSUE 12); multiple jobs in
+            # one run land under their own labels so SEAM_RULES gate each
+            label = e.get("label") or "stream"
+            rec["stream"][label] = {
+                k: float(v) for k, v in e.items()
+                if k not in ("event", "t", "label")
+                and isinstance(v, (int, float)) and not isinstance(v, bool)
+            }
         elif kind == "router_health":
             # the fleet router's summary (ISSUE 11) joins the reliability
             # section under its own label — shared labels across two
@@ -377,7 +415,7 @@ def _rule_values(record: Dict[str, Any], rule: RegressionRule) -> Dict[str, floa
                    for k, v in record.get("device_memory", {}).items()}
     elif rule.kind == "divergence":
         out = {k: float(v) for k, v in record.get("divergence", {}).items()}
-    elif rule.kind in ("timing", "trace", "reliability"):
+    elif rule.kind in ("timing", "trace", "reliability", "stream"):
         for label, m in record.get(rule.kind, {}).items():
             if rule.metric in m:
                 out[label] = float(m[rule.metric])

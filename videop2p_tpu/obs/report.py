@@ -302,6 +302,32 @@ def _quality_section(events) -> str:
             + _table(rows, ["metric", "value"]))
 
 
+def _stream_section(events) -> str:
+    """Streaming long-video jobs (stream/driver.py events): the job
+    summary plus per-seam consistency. Empty for non-streaming ledgers."""
+    health = [e for e in events if e.get("event") == "stream_health"]
+    if not health:
+        return ""
+    skip = {"event", "t", "label"}
+    rows = [[k, v] for e in health for k, v in e.items()
+            if k not in skip and isinstance(v, (int, float))]
+    out = ("<h2>Streaming job</h2>"
+           "<p class=meta>stream/driver.py — windowed long-video edit: "
+           "window outcomes, resume/recovery counters, and seam "
+           "adjacent-frame consistency (gated by SEAM_RULES — seam PSNR "
+           "regresses by dropping, src_err_max must be 0).</p>"
+           + _table(rows, ["metric", "value"]))
+    seams = [e for e in events if e.get("event") == "stream_seam"]
+    if seams:
+        srows = [[e.get("left"), e.get("right"),
+                  f"[{e.get('start')}, {e.get('stop')})",
+                  _fmt(e.get("seam_psnr")), _fmt(e.get("source_psnr"))]
+                 for e in seams]
+        out += _table(srows, ["left", "right", "blend span",
+                              "seam PSNR (dB)", "source PSNR (dB)"])
+    return out
+
+
 def _null_text_section(events) -> str:
     ev = next((e for e in events if e.get("event") == "telemetry"
                and e.get("loss_curve")), None)
@@ -534,6 +560,7 @@ def render_report(events: Sequence[Dict[str, Any]],
         _word_heat_section(events, sidecar),
         _mask_section(events, sidecar),
         _null_text_section(events),
+        _stream_section(events),
         _comm_section(events),
         _time_section(events),
         _verdict_section(events),

@@ -431,6 +431,13 @@ class EditEngine:
         (kept only with ``keep_videos=True``)."""
         return self._videos.get(rid)
 
+    def take_videos(self, rid: str) -> Optional[np.ndarray]:
+        """Pop (and return) one request's kept videos — the streaming
+        driver's memory-flat harvest: a long job holds at most its
+        in-flight windows resident instead of accumulating every decoded
+        window for the life of the engine."""
+        return self._videos.pop(rid, None)
+
     def metrics(self) -> Dict[str, Any]:
         """The live SLO record ``/metrics`` serves: per-program and
         per-phase latency distributions straight from the ledger's

@@ -60,13 +60,22 @@ class _ReplicaView:
     """The router's view of one replica: a fail-fast client plus cached
     health/metrics probes and the suspect window."""
 
-    def __init__(self, name: str, url: str, *, timeout_s: float):
+    def __init__(self, name: str, url: str, *, timeout_s: float,
+                 probe_timeout_s: float = 2.0):
         self.name = name
         self.url = url.rstrip("/")
         # retries=0: the ROUTER owns retry/failover policy, the per-call
         # client must fail fast so a sick replica costs one RTT, not a
         # client-side backoff schedule
         self.client = EngineClient(url, timeout_s=timeout_s, retries=0)
+        # probes ride a SEPARATE, hard-short socket timeout: rank() runs
+        # on every submit, so a replica that ACCEPTS connections but never
+        # answers (a wedged process, a half-dead container) must cost the
+        # router probe_timeout_s once — after which it ranks unreachable
+        # and traffic is routed AROUND it — not wedge the router thread
+        # for the full request timeout
+        self.probe_client = EngineClient(url, timeout_s=probe_timeout_s,
+                                         retries=0)
         self.suspended_until = 0.0
         self.consecutive_failures = 0
         self.routed = 0
@@ -81,13 +90,13 @@ class _ReplicaView:
             if self._probe is not None and now - self._probe[0] < ttl_s:
                 return self._probe[1], self._probe[2]
         try:
-            health = self.client.healthz()
-        except Exception as e:  # noqa: BLE001 — unreachable is a ranking fact
+            health = self.probe_client.healthz()
+        except Exception as e:  # noqa: BLE001 — unreachable/wedged is a ranking fact
             health = {"ok": False, "status": "unreachable", "error": str(e)}
         metrics: Dict[str, Any] = {}
         if health.get("ok"):
             try:
-                metrics = self.client.metrics()
+                metrics = self.probe_client.metrics()
             except Exception:  # noqa: BLE001
                 metrics = {}
         with self._lock:
@@ -121,6 +130,7 @@ class Router:
         replica_urls: Sequence[str],
         *,
         timeout_s: float = 30.0,
+        probe_timeout_s: float = 2.0,
         max_retries: int = 2,
         retry_base_s: float = 0.05,
         retry_cap_s: float = 1.0,
@@ -132,7 +142,8 @@ class Router:
         urls = [str(u) for u in replica_urls if str(u).strip()]
         if not urls:
             raise ValueError("router needs at least one replica URL")
-        self.views = [_ReplicaView(f"replica{i}", u, timeout_s=timeout_s)
+        self.views = [_ReplicaView(f"replica{i}", u, timeout_s=timeout_s,
+                                   probe_timeout_s=probe_timeout_s)
                       for i, u in enumerate(urls)]
         self.retry = RetryPolicy(max_retries=max_retries, base_s=retry_base_s,
                                  cap_s=retry_cap_s)
@@ -256,6 +267,16 @@ class Router:
                 raise KeyError(str(e)) from e
             self._count("proxy_errors")
             raise
+        except Exception as e:  # noqa: BLE001 — network-level: timed out / refused
+            # the client's hard socket timeout bounds a wedged replica;
+            # mark it suspect so the NEXT submit is routed around it
+            # instead of this handler thread being the only one to learn
+            view.suspend(self.suspend_s)
+            self._count("proxy_errors")
+            raise RuntimeError(
+                f"{view.name} unreachable while proxying poll: "
+                f"{type(e).__name__}: {e}"
+            ) from e
         rec["replica"] = view.name
         return rec
 
@@ -268,6 +289,13 @@ class Router:
                 raise KeyError(str(e)) from e
             self._count("proxy_errors")
             raise
+        except Exception as e:  # noqa: BLE001 — network-level: timed out / refused
+            view.suspend(self.suspend_s)
+            self._count("proxy_errors")
+            raise RuntimeError(
+                f"{view.name} unreachable while proxying result: "
+                f"{type(e).__name__}: {e}"
+            ) from e
         rec["replica"] = view.name
         return rec
 

@@ -151,6 +151,12 @@ class InversionStore:
         try:
             loaded = load_persisted_inversion(self.persist_dir, key)
         except Exception:  # noqa: BLE001 — a broken disk layer is a miss, not a crash
+            # an entry that EXISTS but cannot load (truncated npy from a
+            # kill mid-write on a pre-atomic layout, bit rot, a torn copy)
+            # is a detected corruption, not a silent absence — the counter
+            # is the serve_health `store_corrupt` evidence
+            with self._lock:
+                self.disk_corrupt += 1
             return None
         if loaded is None:
             return None

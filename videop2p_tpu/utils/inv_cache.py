@@ -153,9 +153,18 @@ def save_inversion(
     d = _cache_dir(results_dir, key)
     os.makedirs(d, exist_ok=True)
 
+    # write-temp-then-os.replace for EVERY entry file, with the temp name
+    # unique per process: a kill mid-write can never leave a torn visible
+    # entry (readers see the old file or the new one, nothing in between),
+    # and two processes persisting the same key never scribble over each
+    # other's temp (first os.replace wins; both bodies are identical by
+    # construction — the key is content-addressed)
     def _atomic_save(name: str, arr) -> None:
-        tmp = os.path.join(d, f".{name}.tmp.npy")
-        np.save(tmp, np.asarray(arr))
+        tmp = os.path.join(d, f".{name}.{os.getpid()}.tmp.npy")
+        with open(tmp, "wb") as f:
+            np.save(f, np.asarray(arr))
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, os.path.join(d, f"{name}.npy"))
 
     if trajectory is not None and not os.path.exists(
@@ -167,6 +176,12 @@ def save_inversion(
     ):
         _atomic_save(f"null_embeddings{null_tag}", null_embeddings)
     if meta is not None:
-        with open(os.path.join(d, "meta.json"), "w") as f:
+        # meta.json gets the same treatment — it was the one file in the
+        # entry a kill could tear (plain open+dump)
+        tmp = os.path.join(d, f".meta.{os.getpid()}.tmp.json")
+        with open(tmp, "w") as f:
             json.dump(meta, f, indent=1, default=str)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(d, "meta.json"))
     return d
