@@ -206,6 +206,42 @@ def test_collect_step_frontier_parses_partial_output(bench, monkeypatch):
     assert [r["steps"] for r in out] == [50, 20]
 
 
+def test_collect_served_latency_parses_record_and_tolerates_failure(
+        bench, monkeypatch):
+    """ISSUE 14 satellite: the served-latency capture parses the loadgen's
+    final JSON record into the queueing-inclusive e2e percentiles (noise
+    lines skipped), and every failure mode — timeout, bad exit, no record
+    — degrades to None, never an exception."""
+    record = {"requests": 6, "concurrency": 3, "done": 6, "store_hits": 5,
+              "shed": 0, "throughput_rps": 1.5,
+              "latency": {"blocked_p50_s": 0.1, "blocked_p99_s": 0.4,
+                          "blocked_max_s": 0.4}}
+    payload = "[loadgen] warming...\n" + json.dumps(record) + "\n"
+
+    def fake_run(cmd, **kw):
+        return types.SimpleNamespace(stdout=payload, stderr="",
+                                     returncode=0)
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    out = bench.collect_served_latency(timeout_s=1.0)
+    assert out["backend"] == "cpu-tiny" and out["done"] == 6
+    assert out["e2e_p50_s"] == 0.1 and out["e2e_p99_s"] == 0.4
+    assert "segments" not in out  # fake run wrote no span ledgers
+
+    def fake_timeout(cmd, **kw):
+        raise subprocess.TimeoutExpired(cmd, kw.get("timeout"))
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_timeout)
+    assert bench.collect_served_latency(timeout_s=1.0) is None
+
+    def fake_fail(cmd, **kw):
+        return types.SimpleNamespace(stdout="no json here\n",
+                                     stderr="boom", returncode=1)
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_fail)
+    assert bench.collect_served_latency(timeout_s=1.0) is None
+
+
 @pytest.mark.slow
 def test_step_frontier_tool_end_to_end_tiny(bench):
     """The ISSUE 8 frontier acceptance, through the real subprocess at tiny
@@ -447,7 +483,11 @@ def test_report_and_obs_import_only_stdlib_numpy_jax():
     # ISSUE 6 pins: the time-domain modules are IN the guarded set — the
     # stdlib xplane reader must never grow a tensorflow path, and the
     # latency reservoirs must stay stdlib
-    assert {"timing.py", "trace.py"} <= set(obs_files)
+    # ISSUE 14 pins: the tracing/SLO/exposition tier joins — span
+    # emission, budget math and the Prometheus renderer must run on any
+    # box the engine does (no opentelemetry/prometheus_client deps)
+    assert {"timing.py", "trace.py",
+            "spans.py", "slo.py", "prom.py"} <= set(obs_files)
     files += [os.path.join(obs_dir, f) for f in obs_files]
     # ISSUE 7 pins: the serving subsystem is IN the guarded set — the
     # HTTP layer stays stdlib http.server/urllib (no flask/requests), and
@@ -676,6 +716,69 @@ def test_fault_and_serve_health_ledger_event_schema(tmp_path):
     assert rel["error_rate"] == round(1 / 3, 4)
     # pre-PR-9 ledgers extract an empty (but present) reliability section
     assert extract_run([{"event": "run_start"}])["reliability"] == {}
+
+
+def test_span_and_slo_report_ledger_event_schema(tmp_path):
+    """Schema pin (ISSUE 14): the ``span`` and ``slo_report`` ledger
+    events carry their documented field sets, SLO_RULES + SEGMENT_RULES
+    ride in DEFAULT_RULES (kinds "slo" / "segment"), and obs/history.py
+    extracts both new sections — tools/obs_diff.py's SLO/segment tables
+    and exit-1 teeth key on these names."""
+    from videop2p_tpu.obs import RunLedger, read_ledger
+    from videop2p_tpu.obs.history import (
+        DEFAULT_RULES,
+        SEGMENT_RULES,
+        SLO_RULES,
+        extract_run,
+        split_runs,
+    )
+    from videop2p_tpu.obs.slo import (
+        DEFAULT_SLOS,
+        SLO_REPORT_FIELDS,
+        emit_slo_reports,
+    )
+    from videop2p_tpu.obs.spans import (
+        SPAN_EVENT_FIELDS,
+        SPAN_SEGMENTS,
+        Tracer,
+        make_span_id,
+        make_trace_id,
+    )
+
+    assert all(r in DEFAULT_RULES for r in SLO_RULES + SEGMENT_RULES)
+    assert {r.metric for r in SLO_RULES} == {"budget_burn", "compliant"}
+    assert all(r.kind == "slo" for r in SLO_RULES)
+    assert {r.metric for r in SEGMENT_RULES} == {"p50_s", "p99_s"}
+    assert all(r.kind == "segment" for r in SEGMENT_RULES)
+    # the default objectives cover the serving AND streaming tiers
+    assert {s.name for s in DEFAULT_SLOS} == {
+        "availability", "deadline_miss_rate", "served_p99_latency",
+        "seam_min_psnr"}
+
+    path = str(tmp_path / "ledger.jsonl")
+    with RunLedger(path) as led:
+        tracer = Tracer(led, enabled=True)
+        tid = make_trace_id()
+        tracer.emit("serve.dispatch", trace_id=tid, span_id=make_span_id(),
+                    duration_s=0.25, batch_size=2)
+        emit_slo_reports(led, {
+            "reliability": {"serve": {"error_rate": 0.005, "requests": 10,
+                                      "deadline_exceeded": 0}},
+        })
+    by_kind = {}
+    for e in read_ledger(path):
+        by_kind.setdefault(e["event"], e)
+    assert set(SPAN_EVENT_FIELDS) <= set(by_kind["span"])
+    assert by_kind["span"]["name"] in SPAN_SEGMENTS
+    assert set(SLO_REPORT_FIELDS) <= set(by_kind["slo_report"])
+    rec = extract_run(split_runs(read_ledger(path))[-1])
+    assert rec["segments"]["dispatch"]["count"] == 1.0
+    assert rec["segments"]["dispatch"]["p99_s"] == 0.25
+    assert rec["slo"]["availability"]["budget_burn"] == pytest.approx(0.5)
+    assert rec["slo"]["availability"]["compliant"] == 1.0
+    # pre-PR-14 ledgers extract empty (but present) sections
+    old = extract_run([{"event": "run_start"}])
+    assert old["segments"] == {} and old["slo"] == {}
 
 
 def test_router_and_tenant_ledger_event_schema(tmp_path):

@@ -1,0 +1,547 @@
+"""Tracing + SLO tests (ISSUE 14): the span model and traceparent
+propagation (tolerant parsing, concurrent emission without torn lines),
+ledger rotation chains, the Prometheus exposition golden format, the
+engine's full request-lifecycle spans (tracing OFF pinned bit-exact, ON
+yielding the queue/resolve/dispatch/decode critical path under one
+trace), the 2-replica router round trip (router + replica ledgers join
+into ONE causal tree via tools/trace_view.py), the loadgen's per-tenant
+queue-wait attribution, and the SLO engine's error-budget math with
+obs_diff's exit-1 teeth on budget burn and segment-tail regressions.
+"""
+
+import importlib.util
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from videop2p_tpu.obs import RunLedger, read_ledger
+from videop2p_tpu.obs.spans import (
+    SPAN_EVENT_FIELDS,
+    SPAN_SEGMENTS,
+    Tracer,
+    format_traceparent,
+    make_span_id,
+    make_trace_id,
+    parse_traceparent,
+)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        f"{name}_under_tracing_test",
+        os.path.join(_REPO, "tools", f"{name}.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ------------------------------------------------ span model / header ---
+
+
+def test_traceparent_round_trip_and_tolerant_parse():
+    tid, sid = make_trace_id(), make_span_id()
+    assert len(tid) == 32 and len(sid) == 16
+    header = format_traceparent(tid, sid)
+    assert header == f"00-{tid}-{sid}-01"
+    assert parse_traceparent(header) == (tid, sid)
+    # malformed headers from foreign clients degrade to "fresh trace",
+    # never to an error — every rejection returns None
+    for bad in (None, "", 42, "garbage", "00-short-span-01",
+                f"00-{tid}-{sid}",            # too few parts
+                f"00-{'z' * 32}-{sid}-01",    # non-hex trace
+                f"00-{'0' * 32}-{sid}-01",    # all-zeros trace (W3C invalid)
+                f"00-{tid}-{'0' * 16}-01"):   # all-zeros span
+        assert parse_traceparent(bad) is None, bad
+
+
+def test_disabled_tracer_is_inert(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    with RunLedger(path) as led:
+        tracer = Tracer(led, enabled=False)
+        assert tracer.enabled is False
+        assert tracer.emit("serve.request", trace_id=make_trace_id(),
+                           span_id=make_span_id()) is None
+    assert not any(e["event"] == "span" for e in read_ledger(path))
+    # no ledger at all forces disabled even when asked for
+    assert Tracer(None, enabled=True).enabled is False
+
+
+def test_span_event_schema_and_attrs(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    tid, root = make_trace_id(), make_span_id()
+    with RunLedger(path) as led:
+        tracer = Tracer(led, enabled=True)
+        fields = tracer.emit("serve.dispatch", trace_id=tid, span_id=root,
+                             duration_s=0.1234567, batch_size=3)
+        assert fields["duration_s"] == 0.123457  # rounded to 6
+    spans = [e for e in read_ledger(path) if e["event"] == "span"]
+    assert len(spans) == 1
+    assert set(SPAN_EVENT_FIELDS) <= set(spans[0])
+    assert spans[0]["trace_id"] == tid and spans[0]["span_id"] == root
+    assert spans[0]["parent_id"] is None and spans[0]["status"] == "ok"
+    assert spans[0]["batch_size"] == 3          # attrs ride along
+    assert isinstance(spans[0]["wall_ns"], int) and spans[0]["wall_ns"] > 0
+
+
+def test_concurrent_span_emission_no_torn_lines(tmp_path):
+    """8 threads × 25 spans through ONE tracer: every line parses, every
+    span arrives exactly once, and the per-thread parent links survive —
+    the ledger lock is the only serialization point."""
+    path = str(tmp_path / "ledger.jsonl")
+    n_threads, n_spans = 8, 25
+    roots = {}
+    with RunLedger(path) as led:
+        tracer = Tracer(led, enabled=True)
+
+        def worker(t):
+            tid, root = make_trace_id(), make_span_id()
+            roots[t] = (tid, root)
+            tracer.emit("serve.request", trace_id=tid, span_id=root)
+            for i in range(n_spans - 1):
+                tracer.emit("serve.dispatch", trace_id=tid,
+                            span_id=make_span_id(), parent_id=root,
+                            duration_s=0.001 * i, idx=i)
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    spans = [e for e in read_ledger(path) if e["event"] == "span"]
+    assert len(spans) == n_threads * n_spans
+    by_trace = {}
+    for s in spans:
+        assert set(SPAN_EVENT_FIELDS) <= set(s)
+        by_trace.setdefault(s["trace_id"], []).append(s)
+    assert len(by_trace) == n_threads
+    for tid, root in roots.values():
+        tspans = by_trace[tid]
+        assert len(tspans) == n_spans
+        children = [s for s in tspans if s["parent_id"] is not None]
+        assert all(s["parent_id"] == root for s in children)
+        assert sorted(s["idx"] for s in children) == list(range(n_spans - 1))
+
+
+# ------------------------------------------------------ rotation (b) ---
+
+
+def test_ledger_rotation_chain_and_marker(tmp_path):
+    """RunLedger(max_bytes=...) rotates to <stem>.1.jsonl logrotate-style,
+    stamps a ledger_rotated marker into the fresh file, and read_ledger
+    replays the whole chain oldest-first as one stream."""
+    path = str(tmp_path / "serve_ledger.jsonl")
+    with RunLedger(path, max_bytes=1200) as led:
+        for i in range(60):
+            led.event("tick", seq=i, pad="x" * 40)
+    rotated = sorted(p.name for p in tmp_path.glob("serve_ledger.*.jsonl"))
+    assert rotated, "no rotation happened — lower max_bytes"
+    assert "serve_ledger.1.jsonl" in rotated
+    assert os.path.getsize(path) <= 1200 + 512  # live file stays bounded
+    events = read_ledger(path)
+    markers = [e for e in events if e["event"] == "ledger_rotated"]
+    assert len(markers) == len(rotated)
+    for m in markers:
+        assert m["previous"].endswith(".1.jsonl")
+        assert m["rotated_bytes"] > 0 and m["index"] >= 1
+    # the chain replays every tick exactly once, in write order
+    seqs = [e["seq"] for e in events if e["event"] == "tick"]
+    assert seqs == list(range(60))
+    # markers in the newest rotated segments carry ASCENDING indices
+    idx = [m["index"] for m in markers]
+    assert idx == sorted(idx) == list(range(1, len(markers) + 1))
+
+
+def test_run_history_scan_skips_rotated_segments(tmp_path):
+    """RunHistory.scan reads rotated chains through the base ledger only —
+    scanning <stem>.N.jsonl directly would double-count every run."""
+    from videop2p_tpu.obs.history import RunHistory
+
+    path = str(tmp_path / "ledger.jsonl")
+    with RunLedger(path, max_bytes=600) as led:
+        for i in range(40):
+            led.event("tick", seq=i, pad="y" * 30)
+    assert list(tmp_path.glob("ledger.*.jsonl"))  # rotation happened
+    hist = RunHistory.scan(str(tmp_path))
+    assert len(hist.runs) == 1  # one run, not one per segment
+
+
+# --------------------------------------------------- prometheus (a) ----
+
+
+def test_prometheus_golden_format():
+    """Byte-for-byte pin of the text exposition (version 0.0.4): sorted
+    metrics, labeled fan-out sections, bools as 1/0, non-finite literals,
+    strings skipped."""
+    from videop2p_tpu.obs.prom import (
+        PROMETHEUS_CONTENT_TYPE,
+        render_prometheus,
+    )
+
+    metrics = {
+        "warm": True,
+        "spec": "abc123",                    # identity string: skipped
+        "queue_depth": 2,
+        "compile": {"events": 4, "total_s": 1.25},
+        "requests": {"done": 3, "error": 1},
+        "tenants": {"a": {"error_rate": 0.0, "requests": 2}},
+        "replicas": {"r0": {"healthy": True, "requests": {"done": 3},
+                            "nan_gauge": float("nan")}},
+        "inf_gauge": float("inf"),
+    }
+    assert render_prometheus(metrics) == (
+        "# TYPE videop2p_compile_events gauge\n"
+        "videop2p_compile_events 4\n"
+        "# TYPE videop2p_compile_total_s gauge\n"
+        "videop2p_compile_total_s 1.25\n"
+        "# TYPE videop2p_inf_gauge gauge\n"
+        "videop2p_inf_gauge +Inf\n"
+        "# TYPE videop2p_queue_depth gauge\n"
+        "videop2p_queue_depth 2\n"
+        "# TYPE videop2p_replica_healthy gauge\n"
+        'videop2p_replica_healthy{replica="r0"} 1\n'
+        "# TYPE videop2p_replica_nan_gauge gauge\n"
+        'videop2p_replica_nan_gauge{replica="r0"} NaN\n'
+        "# TYPE videop2p_replica_requests_total gauge\n"
+        'videop2p_replica_requests_total{replica="r0",status="done"} 3\n'
+        "# TYPE videop2p_requests_total gauge\n"
+        'videop2p_requests_total{status="done"} 3\n'
+        'videop2p_requests_total{status="error"} 1\n'
+        "# TYPE videop2p_tenant_error_rate gauge\n"
+        'videop2p_tenant_error_rate{tenant="a"} 0\n'
+        "# TYPE videop2p_tenant_requests gauge\n"
+        'videop2p_tenant_requests{tenant="a"} 2\n'
+        "# TYPE videop2p_warm gauge\n"
+        "videop2p_warm 1\n"
+    )
+    assert render_prometheus({}) == ""
+    assert "version=0.0.4" in PROMETHEUS_CONTENT_TYPE
+
+
+# ------------------------------------------------------ SLO engine -----
+
+
+def test_slo_budget_burn_math_and_absent_metric_skip():
+    from videop2p_tpu.obs.slo import SLO_REPORT_FIELDS, SLOSpec, evaluate_slos
+
+    specs = (
+        SLOSpec("availability", "reliability", "serve", "error_rate",
+                target=0.01, mode="rate_max"),
+        SLOSpec("served_p99", "timing", "e2e", "blocked_p99_s",
+                target=10.0, mode="value_max"),
+        SLOSpec("seam_psnr", "stream", "stream", "seam_min_psnr",
+                target=15.0, mode="value_min"),
+        SLOSpec("absent", "timing", "nope", "blocked_p99_s", target=1.0),
+    )
+    record = {
+        "reliability": {"serve": {"error_rate": 0.02}},   # 2× the budget
+        "timing": {"e2e": {"blocked_p99_s": 5.0}},        # half the budget
+        "stream": {"stream": {"seam_min_psnr": 30.0}},    # 2× the floor
+    }
+    results = {r["name"]: r for r in evaluate_slos(record, specs)}
+    assert "absent" not in results  # missing metric SKIPS, never fakes
+    for r in results.values():
+        assert set(SLO_REPORT_FIELDS) == set(r)
+    assert results["availability"]["budget_burn"] == pytest.approx(2.0)
+    assert results["availability"]["compliant"] is False
+    assert results["served_p99"]["budget_burn"] == pytest.approx(0.5)
+    assert results["served_p99"]["compliant"] is True
+    # value_min burns as target/actual: more headroom = less burn
+    assert results["seam_psnr"]["budget_burn"] == pytest.approx(0.5)
+    assert results["seam_psnr"]["compliant"] is True
+
+
+def test_obs_diff_gates_slo_burn_and_segment_tail(tmp_path):
+    """THE gate acceptance: obs_diff exits 0 on self-compare, 1 when the
+    candidate burns >25% more error budget, and 1 when one critical-path
+    segment's p99 regresses — naming WHICH stage moved."""
+    from videop2p_tpu.obs.slo import emit_slo_reports
+
+    def write(path, *, err_scale=1.0, seg_scale=1.0):
+        with RunLedger(str(path)) as led:
+            tracer = Tracer(led, enabled=True)
+            tid = make_trace_id()
+            for i in range(8):
+                for name in SPAN_SEGMENTS:
+                    scale = seg_scale if name == "serve.dispatch" else 1.0
+                    tracer.emit(name, trace_id=tid, span_id=make_span_id(),
+                                duration_s=scale * (0.05 + 0.01 * i))
+            emit_slo_reports(led, {
+                "reliability": {"serve": {"error_rate": 0.004 * err_scale}},
+            })
+        return str(path)
+
+    base = write(tmp_path / "base.jsonl")
+    burn = write(tmp_path / "burn.jsonl", err_scale=3.0)
+    seg = write(tmp_path / "seg.jsonl", seg_scale=2.0)
+    obs_diff = _load_tool("obs_diff")
+    assert obs_diff.main(["obs_diff.py", base, base]) == 0
+    assert obs_diff.main(["obs_diff.py", base, burn]) == 1
+    assert obs_diff.main(["obs_diff.py", base, seg]) == 1
+
+
+# ------------------------------------------------- trace_view tool -----
+
+
+def test_trace_view_joins_ledgers_into_one_tree(tmp_path, capsys):
+    """Spans scattered across TWO ledgers (a router's and a replica's)
+    join into one causal tree keyed on trace_id, with the critical-path
+    split summed from the segment spans."""
+    tid = make_trace_id()
+    root, mid = make_span_id(), make_span_id()
+    with RunLedger(str(tmp_path / "router.jsonl")) as led:
+        Tracer(led, enabled=True).emit(
+            "router.submit", trace_id=tid, span_id=root,
+            duration_s=0.5, replica="r0")
+    with RunLedger(str(tmp_path / "replica.jsonl")) as led:
+        tr = Tracer(led, enabled=True)
+        tr.emit("serve.request", trace_id=tid, span_id=mid,
+                parent_id=root, duration_s=0.4)
+        tr.emit("serve.dispatch", trace_id=tid, span_id=make_span_id(),
+                parent_id=mid, duration_s=0.3)
+        tr.emit("stray", trace_id=make_trace_id(), span_id=make_span_id())
+
+    trace_view = _load_tool("trace_view")
+    paths = [str(tmp_path / "router.jsonl"), str(tmp_path / "replica.jsonl")]
+    assert trace_view.main(["--json"] + paths) == 0
+    doc = json.loads(capsys.readouterr().out)
+    joined = [t for t in doc["traces"] if t["trace_id"] == tid]
+    assert len(joined) == 1 and len(joined[0]["spans"]) == 3
+    assert joined[0]["segments"] == {"dispatch": pytest.approx(0.3)}
+    assert doc["segment_percentiles"]["dispatch"]["count"] == 1
+    # the tree renders with the router span as root
+    assert trace_view.main(["--trace", tid[:8]] + paths) == 0
+    out = capsys.readouterr().out
+    assert "router.submit" in out and out.index("router.submit") < \
+        out.index("serve.request") < out.index("serve.dispatch")
+    # zero spans is "tracing was off", not breakage; unreadable input is
+    assert trace_view.main([str(tmp_path / "router.jsonl"),
+                            "--trace", "ffff"]) == 0
+    capsys.readouterr()
+    assert trace_view.main([str(tmp_path / "missing.jsonl")]) == 2
+
+
+# --------------------------------------------- engine lifecycle (CPU) ---
+
+_SPEC_KW = dict(checkpoint=None, tiny=True, width=16, video_len=2, steps=2)
+_PROMPTS = ("a rabbit is jumping", "a origami rabbit is jumping")
+
+
+@pytest.fixture(scope="module")
+def programs():
+    from videop2p_tpu.serve import ProgramSet, ProgramSpec
+
+    ps = ProgramSet(ProgramSpec(**_SPEC_KW))
+    ps.warm(_PROMPTS, batch_sizes=(2,))
+    return ps
+
+
+def _request(**overrides):
+    from videop2p_tpu.serve import EditRequest
+
+    kw = dict(image_path="data/rabbit", prompt=_PROMPTS[0],
+              prompts=list(_PROMPTS), save_name="traced")
+    kw.update(overrides)
+    return EditRequest(**kw)
+
+
+def _engine(programs, tmp_root, name, **kw):
+    from videop2p_tpu.serve import EditEngine, ProgramSpec
+
+    return EditEngine(
+        ProgramSpec(**_SPEC_KW), out_dir=os.path.join(tmp_root, name),
+        programs=programs, keep_videos=True, **kw,
+    )
+
+
+def test_engine_tracing_off_bit_exact_and_on_full_lifecycle(
+        programs, tmp_path):
+    """THE single-engine acceptance: tracing OFF writes zero span events
+    and the result record carries no trace fields (bit-exact off path);
+    tracing ON yields the SAME video with the full lifecycle under one
+    trace — a serve.request root and every critical-path segment."""
+    off = _engine(programs, str(tmp_path), "off")
+    try:
+        r_off = off.result(off.submit(_request(seed=7)), wait_s=300.0)
+        assert r_off["status"] == "done", r_off.get("error")
+        v_off = off.videos(r_off["id"])
+    finally:
+        off.close()
+    assert "trace_id" not in r_off
+    assert not any(e["event"] == "span"
+                   for e in read_ledger(off.ledger.path))
+
+    on = _engine(programs, str(tmp_path), "on", tracing=True, slo=True)
+    try:
+        rid = on.submit(_request(seed=7))
+        r_on = on.result(rid, wait_s=300.0)
+        assert r_on["status"] == "done", r_on.get("error")
+        v_on = on.videos(r_on["id"])
+    finally:
+        on.close()
+    assert np.array_equal(v_off, v_on)  # tracing never touches the math
+    tid = r_on["trace_id"]
+    assert len(tid) == 32
+    spans = [e for e in read_ledger(on.ledger.path) if e["event"] == "span"]
+    mine = [s for s in spans if s["trace_id"] == tid]
+    names = {s["name"] for s in mine}
+    assert set(SPAN_SEGMENTS) <= names  # queue/resolve/dispatch/decode
+    assert "serve.request" in names and "serve.batch" in names
+    roots = [s for s in mine if s["name"] == "serve.request"]
+    assert len(roots) == 1 and roots[0]["status"] == "done"
+    assert roots[0]["span_id"] == r_on["span_id"]
+    # every lifecycle span parents onto the request root
+    for s in mine:
+        if s["name"] in SPAN_SEGMENTS:
+            assert s["parent_id"] == roots[0]["span_id"]
+    # the close()-time SLO evaluation landed compliant objectives
+    reports = [e for e in read_ledger(on.ledger.path)
+               if e["event"] == "slo_report"]
+    assert {r["name"] for r in reports} >= {"availability"}
+    assert all(r["compliant"] for r in reports)
+    # ... and history extracts both new sections for the diff gates
+    from videop2p_tpu.obs.history import extract_run, split_runs
+
+    rec = extract_run(split_runs(read_ledger(on.ledger.path))[-1])
+    assert set(rec["segments"]) == set(SPAN_SEGMENTS.values())
+    assert rec["slo"]["availability"]["compliant"] == 1.0
+
+
+def test_engine_continues_caller_traceparent(programs, tmp_path):
+    """An inbound traceparent re-parents the whole request tree under the
+    caller's trace — the cross-hop join contract."""
+    caller_tid, caller_span = make_trace_id(), make_span_id()
+    eng = _engine(programs, str(tmp_path), "cont", tracing=True)
+    try:
+        rid = eng.submit(
+            _request(seed=9),
+            traceparent=format_traceparent(caller_tid, caller_span))
+        rec = eng.result(rid, wait_s=300.0)
+        assert rec["status"] == "done", rec.get("error")
+        assert rec["trace_id"] == caller_tid
+        # malformed header degrades to a fresh trace, not an error
+        rid2 = eng.submit(_request(seed=10), traceparent="bogus-header")
+        rec2 = eng.result(rid2, wait_s=300.0)
+        assert rec2["status"] == "done" and len(rec2["trace_id"]) == 32
+        assert rec2["trace_id"] != caller_tid
+    finally:
+        eng.close()
+    spans = [e for e in read_ledger(eng.ledger.path)
+             if e["event"] == "span" and e["trace_id"] == caller_tid]
+    roots = [s for s in spans if s["name"] == "serve.request"]
+    assert len(roots) == 1 and roots[0]["parent_id"] == caller_span
+
+
+# ------------------------------------------ fleet round trip (HTTP) -----
+
+
+@pytest.fixture(scope="module")
+def traced_fleet(programs, tmp_path_factory):
+    """Two inproc replicas (tracing ON) behind a tracing router's HTTP
+    front door — the 2-replica acceptance fixture."""
+    from videop2p_tpu.serve import ReplicaSupervisor, Router, RouterServer
+
+    root = tmp_path_factory.mktemp("traced_fleet")
+    sup = ReplicaSupervisor(
+        programs.spec, 2, out_dir=str(root), programs=programs,
+        warm_prompts=_PROMPTS,
+        engine_kwargs=dict(keep_videos=True, tracing=True),
+    )
+    sup.start()
+    router = Router(sup.urls, probe_ttl_s=0.05, tracing=True,
+                    ledger_path=str(root / "router_ledger.jsonl"))
+    server = RouterServer(router).start()
+    yield sup, router, server
+    server.close()
+    sup.stop()
+
+
+def test_router_replica_traceparent_round_trip(traced_fleet, tmp_path,
+                                               capsys):
+    """THE fleet acceptance: a traced request through the router's real
+    HTTP hop produces router AND replica spans sharing one trace_id, and
+    trace_view joins the ledgers into one tree with the segment split."""
+    from videop2p_tpu.serve.client import EngineClient
+
+    sup, router, server = traced_fleet
+    client = EngineClient(server.url)
+    tids = []
+    for seed in (21, 22):
+        tid, sid = make_trace_id(), make_span_id()
+        rid = client.submit({**_request(seed=seed).to_dict()},
+                            traceparent=format_traceparent(tid, sid))
+        rec = client.wait(rid, timeout_s=300.0)
+        assert rec["status"] == "done", rec.get("error")
+        tids.append(tid)
+
+    router_spans = [e for e in read_ledger(router.ledger.path)
+                    if e["event"] == "span"]
+    assert {s["trace_id"] for s in router_spans} >= set(tids)
+    replica_ledgers = [r.engine.ledger.path for r in sup.replicas]
+    replica_spans = [e for p in replica_ledgers for e in read_ledger(p)
+                     if e["event"] == "span"]
+    for tid in tids:
+        mine = [s for s in replica_spans if s["trace_id"] == tid]
+        names = {s["name"] for s in mine}
+        assert set(SPAN_SEGMENTS) <= names and "serve.request" in names
+        # the replica's root hangs off the ROUTER's span — the HTTP hop
+        # carried the re-parented traceparent, not the caller's
+        rspan = next(s for s in router_spans if s["trace_id"] == tid)
+        root = next(s for s in mine if s["name"] == "serve.request")
+        assert root["parent_id"] == rspan["span_id"]
+
+    trace_view = _load_tool("trace_view")
+    assert trace_view.main(
+        ["--json", router.ledger.path] + replica_ledgers) == 0
+    doc = json.loads(capsys.readouterr().out)
+    joined = {t["trace_id"]: t for t in doc["traces"]}
+    for tid in tids:
+        assert len(joined[tid]["ledgers"]) >= 2   # the JOIN happened
+    assert set(doc["segment_percentiles"]) == set(SPAN_SEGMENTS.values())
+
+    # satellite (a) rides the same fleet: both tiers serve the Prometheus
+    # exposition over real HTTP
+    text = client.metrics_prometheus()
+    assert "# TYPE videop2p_replica_requests_total gauge" in text
+    assert 'videop2p_replica_in_flight{replica="replica0"} 0' in text
+    rtext = EngineClient(sup.urls[0]).metrics_prometheus()
+    assert "# TYPE videop2p_queue_depth gauge" in rtext
+
+
+def test_loadgen_per_tenant_queue_wait_and_slo(programs, tmp_path):
+    """Satellite (c): the loadgen threads the engine's queue_wait_s into
+    per-tenant reservoirs — starvation shows up per lane — and --slo
+    lands slo_report events in the loadgen ledger."""
+    loadgen = _load_tool("serve_loadgen")
+    eng = _engine(programs, str(tmp_path), "lg",
+                  scheduler="fair", tenants="A:3,B:1", tracing=True)
+    try:
+        record = loadgen.run_loadgen(
+            loadgen._InprocTarget(eng, timeout_s=300.0),
+            _request().to_dict(),
+            requests=4, concurrency=2,
+            ledger_path=str(tmp_path / "lg.jsonl"),
+            meta={"target": "test"}, tenants={"A": 3, "B": 1},
+            tracing=True, slo=True,
+        )
+    finally:
+        eng.close()
+    assert record["done"] == 4
+    for t in ("A", "B"):
+        assert record["tenants"][t]["queue_wait_p99_s"] is not None
+        assert record["tenants"][t]["queue_wait_p99_s"] >= 0.0
+    events = read_ledger(str(tmp_path / "lg.jsonl"))
+    assert [e for e in events if e["event"] == "span"]
+    reports = {e["name"]: e for e in events if e["event"] == "slo_report"}
+    assert {"availability", "deadline_miss_rate"} <= set(reports)
+    assert all(r["compliant"] for r in reports.values())
+    # the e2e reservoir carries its exemplar trace ids (tracing was on)
+    timing = [e for e in events if e["event"] == "execute_timing"
+              and e["program"] == "loadgen_request"]
+    assert timing and timing[-1]["max_trace_id"]

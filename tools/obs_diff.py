@@ -21,7 +21,12 @@ regress by appearing/growing, gated by ``FAULT_RULES``), and streaming
 long-video jobs (``stream_health`` events — stream/driver.py: window-seam
 adjacent-frame PSNR regresses by DROPPING, window failures/passthroughs
 and manifest corruption by appearing, ``src_err_max`` must be exactly 0 —
-gated by ``SEAM_RULES``)
+gated by ``SEAM_RULES``), request-trace critical-path segments (``span``
+events — obs/spans.py: per-segment queue/resolve/dispatch/decode p50/p99
+regress by growing, gated by ``SEGMENT_RULES``), and SLO compliance
+(``slo_report`` events — obs/slo.py: per-objective error-budget burn
+regresses by growing, a compliant→violating flip always fails — gated by
+``SLO_RULES``)
 between a baseline run and a new run, renders per-program tables,
 evaluates the declarative regression rules (obs/history.py DEFAULT_RULES;
 scale every threshold with ``--threshold-scale``), and:
@@ -300,6 +305,64 @@ def render_diff(base: Dict, new: Dict, result: Dict) -> str:
                 _table(rows, ["label", "windows", "done", "passthrough",
                               "failed", "seam_min", "seam_mean",
                               "src_err_max"])]
+
+    # critical-path segments (span events — obs/spans.py, ISSUE 14):
+    # absent/empty for tracing-off ledgers, table omitted
+    segs = sorted(set(base.get("segments") or {})
+                  | set(new.get("segments") or {}))
+    if segs:
+        rows = []
+        for label in segs:
+            b = (base.get("segments") or {}).get(label, {})
+            n = (new.get("segments") or {}).get(label, {})
+
+            def gcell(metric, scale=1e3, b=b, n=n):
+                bv, nv = b.get(metric), n.get(metric)
+                if bv is None and nv is None:
+                    return "-"
+                if bv is None or nv is None:
+                    return f"{_fmt(bv)} → {_fmt(nv)}"
+                if bv == nv:
+                    return f"{nv * scale:.2f}"
+                pct = (nv / bv - 1.0) * 100.0 if bv else float("inf")
+                return f"{bv * scale:.2f} → {nv * scale:.2f} ({pct:+.1f}%)"
+
+            cnt_b, cnt_n = b.get("count"), n.get("count")
+            cnt = (_fmt(cnt_n) if cnt_b == cnt_n
+                   else f"{_fmt(cnt_b)} → {_fmt(cnt_n)}")
+            rows.append([label, cnt, gcell("p50_s"), gcell("p99_s"),
+                         gcell("max_s")])
+        out += ["", "trace segments (critical-path ms per request — queue/"
+                "resolve/dispatch/decode):",
+                _table(rows, ["segment", "spans", "p50", "p99", "max"])]
+
+    # SLO section (slo_report events — obs/slo.py, ISSUE 14): budget burn
+    # regresses by growing; compliant regresses by flipping to 0
+    slos = sorted(set(base.get("slo") or {}) | set(new.get("slo") or {}))
+    if slos:
+        rows = []
+        for name in slos:
+            b = (base.get("slo") or {}).get(name, {})
+            n = (new.get("slo") or {}).get(name, {})
+
+            def ocell(metric, b=b, n=n):
+                bv, nv = b.get(metric), n.get(metric)
+                if bv is None and nv is None:
+                    return "-"
+                if bv is None or nv is None:
+                    return f"{_fmt(bv)} → {_fmt(nv)}"
+                if bv == nv:
+                    return _fmt(nv)
+                return f"{_fmt(bv)} → {_fmt(nv)}"
+
+            verdict = "-"
+            if n:
+                verdict = "ok" if n.get("compliant") else "VIOLATED"
+            rows.append([name, ocell("target"), ocell("actual"),
+                         ocell("budget_burn"), verdict])
+        out += ["", "SLOs (slo_report — budget burn regresses by growing):",
+                _table(rows, ["objective", "target", "actual", "burn",
+                              "verdict"])]
 
     comp = sorted(set(base.get("compiles", {})) | set(new.get("compiles", {})))
     if comp:

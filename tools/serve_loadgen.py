@@ -68,9 +68,10 @@ class _HttpTarget:
         self.client = EngineClient(url)
         self.timeout_s = timeout_s
 
-    def one(self, request: Dict[str, Any]) -> Dict[str, Any]:
+    def one(self, request: Dict[str, Any],
+            traceparent: Optional[str] = None) -> Dict[str, Any]:
         t0 = time.perf_counter()
-        rid = self.client.submit(request)
+        rid = self.client.submit(request, traceparent=traceparent)
         submit_s = time.perf_counter() - t0
         rec = self.client.wait(rid, timeout_s=self.timeout_s)
         rec["_submit_s"] = submit_s
@@ -83,11 +84,13 @@ class _InprocTarget:
         self.engine = engine
         self.timeout_s = timeout_s
 
-    def one(self, request: Dict[str, Any]) -> Dict[str, Any]:
+    def one(self, request: Dict[str, Any],
+            traceparent: Optional[str] = None) -> Dict[str, Any]:
         from videop2p_tpu.serve.engine import EditRequest
 
         t0 = time.perf_counter()
-        rid = self.engine.submit(EditRequest.from_dict(request))
+        rid = self.engine.submit(EditRequest.from_dict(request),
+                                 traceparent=traceparent)
         submit_s = time.perf_counter() - t0
         rec = self.engine.result(rid, wait_s=self.timeout_s)
         rec["_submit_s"] = submit_s
@@ -160,6 +163,8 @@ def run_loadgen(
     collect_extra=None,
     tenants: Optional[Dict[str, int]] = None,
     mutate_request=None,
+    tracing: bool = False,
+    slo: bool = False,
 ) -> Dict[str, Any]:
     """Run the closed loop; returns the summary record (also printed as one
     JSON line by :func:`main`). When ``ledger_path`` is given, the
@@ -171,17 +176,32 @@ def run_loadgen(
     reliability obs_diff-gateable. ``tenants`` (name → weight) tags each
     request on the deterministic :func:`tenant_cycle` and adds per-tenant
     latency/shed accounting. ``mutate_request(req, issue_index)`` is the
-    per-request hook (``--distinct_seeds`` rides it)."""
+    per-request hook (``--distinct_seeds`` rides it).
+
+    ``tracing`` (ISSUE 14) mints a client-side root span per request and
+    forwards its traceparent to the target — the engine/router/replica
+    ledgers then share the loadgen's trace ids, and the `loadgen.request`
+    spans land in THIS ledger so trace_view joins the full client→fleet
+    tree. ``slo`` evaluates the default objectives over the run's own
+    summaries into ``slo_report`` events (obs_diff's SLO_RULES gate
+    them)."""
     from videop2p_tpu.obs.timing import LatencyReservoir
 
     reservoirs = {
         "loadgen_request": LatencyReservoir(),
         "loadgen_submit": LatencyReservoir(),
+        # the engine-reported admit→dispatch queue wait, threaded back
+        # per tenant so fair-scheduler starvation is VISIBLE client-side
+        # (a starved lane shows a fat queue-wait p99 with a normal
+        # dispatch latency)
+        "loadgen_queue_wait": LatencyReservoir(),
     }
     assignment = tenant_cycle(tenants or {}, requests) if tenants else None
     tenant_names = sorted(tenants) if tenants else []
     for t in tenant_names:
         reservoirs[f"loadgen_request_{t}"] = LatencyReservoir()
+        reservoirs[f"loadgen_queue_wait_{t}"] = LatencyReservoir()
+    spans: List[Dict[str, Any]] = []  # buffered; the ledger opens at the end
     lock = threading.Lock()
     counters = {"done": 0, "errors": 0, "deadline_exceeded": 0, "shed": 0,
                 "store_hits": 0, "issued": 0}
@@ -205,14 +225,33 @@ def run_loadgen(
                     tcounters[tenant]["requests"] += 1
             if mutate_request is not None:
                 req = mutate_request(req, idx)
+            tid = span_id = tp = None
+            wall0 = 0
+            if tracing:
+                from videop2p_tpu.obs.spans import (
+                    format_traceparent,
+                    make_span_id,
+                    make_trace_id,
+                )
+
+                tid, span_id = make_trace_id(), make_span_id()
+                tp = format_traceparent(tid, span_id)
+                wall0 = time.time_ns()
             try:
-                rec = target.one(req)
+                rec = target.one(req, tp)
             except Exception as e:  # noqa: BLE001 — a failed request is a counter, not a crash
                 kind = "shed" if _is_shed(e) else "errors"
                 with lock:
                     counters[kind] += 1
                     if tenant is not None:
                         tcounters[tenant][kind] += 1
+                    if tracing:
+                        spans.append({
+                            "trace_id": tid, "span_id": span_id,
+                            "parent_id": None, "name": "loadgen.request",
+                            "wall_ns": wall0, "duration_s": 0.0,
+                            "status": kind, "index": idx, "tenant": tenant,
+                        })
                 print(f"[loadgen] request failed: {e}", file=sys.stderr)
                 continue
             with lock:
@@ -230,12 +269,32 @@ def run_loadgen(
                            "deadline_exceeded": "deadline_exceeded"}.get(
                                status, "errors")
                     tcounters[tenant][key] += 1
-            reservoirs["loadgen_request"].add(rec["_e2e_s"], rec["_e2e_s"])
-            reservoirs["loadgen_submit"].add(rec["_submit_s"], rec["_submit_s"])
+            reservoirs["loadgen_request"].add(rec["_e2e_s"], rec["_e2e_s"],
+                                              tid)
+            reservoirs["loadgen_submit"].add(rec["_submit_s"],
+                                             rec["_submit_s"], tid)
+            qw = rec.get("queue_wait_s")
+            if isinstance(qw, (int, float)):
+                reservoirs["loadgen_queue_wait"].add(float(qw), float(qw),
+                                                     tid)
             if tenant is not None:
                 reservoirs[f"loadgen_request_{tenant}"].add(
-                    rec["_e2e_s"], rec["_e2e_s"]
+                    rec["_e2e_s"], rec["_e2e_s"], tid
                 )
+                if isinstance(qw, (int, float)):
+                    reservoirs[f"loadgen_queue_wait_{tenant}"].add(
+                        float(qw), float(qw), tid
+                    )
+            if tracing:
+                with lock:
+                    spans.append({
+                        "trace_id": tid, "span_id": span_id,
+                        "parent_id": None, "name": "loadgen.request",
+                        "wall_ns": wall0,
+                        "duration_s": round(rec["_e2e_s"], 6),
+                        "status": rec.get("status") or "ok",
+                        "index": idx, "tenant": tenant,
+                    })
 
     t0 = time.perf_counter()
     threads = [threading.Thread(target=worker, daemon=True)
@@ -269,6 +328,7 @@ def run_loadgen(
         for t in tenant_names:
             c = tcounters[t]
             lat = summaries.get(f"loadgen_request_{t}") or {}
+            qw = summaries.get(f"loadgen_queue_wait_{t}") or {}
             attempted = max(c["requests"], 1)
             per_tenant[t] = {
                 **c,
@@ -277,6 +337,10 @@ def run_loadgen(
                     c["done"] / max(c["requests"] - c["shed"], 1), 4),
                 "p50_s": lat.get("blocked_p50_s"),
                 "p99_s": lat.get("blocked_p99_s"),
+                # the engine-side queue wait per lane: fair-scheduler
+                # starvation shows up HERE even when dispatch is healthy
+                "queue_wait_p50_s": qw.get("blocked_p50_s"),
+                "queue_wait_p99_s": qw.get("blocked_p99_s"),
             }
         record["tenants"] = per_tenant
     extra_events = []
@@ -291,14 +355,37 @@ def run_loadgen(
         led = RunLedger(
             ledger_path,
             meta={"cli": "serve_loadgen", **(meta or {}),
-                  "requests": requests, "concurrency": concurrency},
+                  "requests": requests, "concurrency": concurrency,
+                  "tracing": bool(tracing)},
         )
         for name, res in reservoirs.items():
-            for d, b in res.samples():
-                led.record_execute(name, d, b)
+            for d, b, t in res.samples():
+                led.record_execute(name, d, b, t)
+        for s in spans:
+            led.event("span", **s)
         for e in extra_events:
             ev = dict(e)
             led.event(ev.pop("event", "fault"), **ev)
+        if slo:
+            from videop2p_tpu.obs.slo import emit_slo_reports
+
+            # the run's own summaries shaped like an extracted record:
+            # availability/deadline objectives over the loop counters,
+            # the served-p99 objective over the e2e reservoir
+            accepted_n = max(requests - counters["shed"], 1)
+            pseudo = {
+                "reliability": {"serve": {
+                    "requests": float(requests),
+                    "deadline_exceeded": float(
+                        counters["deadline_exceeded"]),
+                    "error_rate": round(
+                        (counters["errors"] + counters["deadline_exceeded"])
+                        / accepted_n, 6),
+                }},
+                "timing": {"serve_request_e2e":
+                           summaries.get("loadgen_request") or {}},
+            }
+            emit_slo_reports(led, pseudo)
         led.event("loadgen_summary", **{k: v for k, v in record.items()
                                         if k not in ("latency", "tenants")})
         led.close()  # flushes execute_timing events
@@ -354,6 +441,17 @@ def main(argv=None) -> int:
                          "p50/p99 + shed rates. Also passed as the engine's "
                          "QoS config in --inproc/--router modes")
     ap.add_argument("--ledger", type=str, default="loadgen_ledger.jsonl")
+    ap.add_argument("--tracing", action="store_true",
+                    help="request-scoped tracing (ISSUE 14): mint a client "
+                         "root span per request, forward traceparent to "
+                         "the target, and record loadgen.request spans in "
+                         "the ledger; --inproc/--router engines (and the "
+                         "router itself) trace server-side with the SAME "
+                         "trace ids — join with tools/trace_view.py")
+    ap.add_argument("--slo", action="store_true",
+                    help="evaluate the default SLOs over this run's "
+                         "summaries into slo_report ledger events "
+                         "(obs_diff SLO_RULES gate the budget burn)")
     # in-process engine knobs (smoke + fleet modes)
     ap.add_argument("--tiny", action="store_true", default=None)
     ap.add_argument("--steps", type=int, default=4)
@@ -428,6 +526,8 @@ def main(argv=None) -> int:
             breaker_open_s=args.breaker_open_s,
             scheduler=args.scheduler,
             tenants=args.tenants,
+            tracing=args.tracing,
+            slo=args.slo,
         )
 
     if args.url:
@@ -482,7 +582,13 @@ def main(argv=None) -> int:
         print(f"[loadgen] starting {args.router}-replica fleet "
               f"(shared store: {supervisor.persist_dir})...")
         supervisor.start()
-        router = Router(supervisor.urls, probe_ttl_s=0.1)
+        router_ledger = None
+        if args.tracing:
+            os.makedirs(args.out_dir, exist_ok=True)
+            router_ledger = os.path.join(args.out_dir,
+                                         "router_ledger.jsonl")
+        router = Router(supervisor.urls, probe_ttl_s=0.1,
+                        ledger_path=router_ledger, tracing=args.tracing)
         router_server = RouterServer(router).start()
         target = _HttpTarget(router_server.url, args.timeout_s)
         meta = {"target": f"router[{args.router}]", "tiny": tiny,
@@ -544,6 +650,8 @@ def main(argv=None) -> int:
             collect_extra=collect_extra,
             tenants=tenant_weights or None,
             mutate_request=mutate_request,
+            tracing=args.tracing,
+            slo=args.slo,
         )
     finally:
         if router_server is not None:

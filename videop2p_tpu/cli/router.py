@@ -67,6 +67,12 @@ def build_parser() -> argparse.ArgumentParser:
                     help="suspect window after a replica refuses a submit")
     ap.add_argument("--probe_ttl_s", type=float, default=0.5,
                     help="health/metrics probe cache TTL")
+    ap.add_argument("--tracing", action="store_true",
+                    help="request-scoped tracing (obs/spans.py): record a "
+                         "router.submit span per routed request and "
+                         "forward a child traceparent to the chosen "
+                         "replica — run the replicas with --tracing too "
+                         "and join the ledgers with tools/trace_view.py")
     return ap
 
 
@@ -108,6 +114,7 @@ def main(argv=None) -> int:
         suspend_s=args.suspend_s, probe_ttl_s=args.probe_ttl_s,
         ledger_path=(args.ledger
                      or os.path.join(args.out_dir, "router_ledger.jsonl")),
+        tracing=args.tracing,
     )
     server = RouterServer(router, host=args.host, port=args.port)
     print(f"[router] listening on {server.url} over {len(urls)} replica(s):")

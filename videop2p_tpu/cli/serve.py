@@ -135,6 +135,20 @@ def build_parser() -> argparse.ArgumentParser:
                          ".py DSL, e.g. 'fail@2,hang@4:1.5,unavail@5-7,"
                          "corrupt:*'); also via VIDEOP2P_SERVE_FAULTS — "
                          "chaos testing only")
+    # request tracing + SLOs (ISSUE 14 — docs/OBSERVABILITY.md Layer 5)
+    ap.add_argument("--tracing", action="store_true",
+                    help="request-scoped distributed tracing (obs/spans"
+                         ".py): every request's admit→queue→resolve→"
+                         "dispatch→decode lifecycle lands as span ledger "
+                         "events; inbound traceparent headers continue the "
+                         "caller's trace — join ledgers with "
+                         "tools/trace_view.py. Off: bit-exact, zero "
+                         "per-request overhead")
+    ap.add_argument("--slo", action="store_true",
+                    help="evaluate the default SLO objectives (obs/slo.py: "
+                         "availability, deadline-miss rate, served p99) "
+                         "over the run at shutdown into slo_report ledger "
+                         "events — obs_diff SLO_RULES gate budget burn")
     return ap
 
 
@@ -178,6 +192,8 @@ def main(argv=None) -> int:
         breaker_threshold=args.breaker_threshold,
         breaker_open_s=args.breaker_open_s,
         faults=faults,
+        tracing=args.tracing,
+        slo=args.slo,
     )
     if not args.no_warm:
         print(f"[serve] warming programs (spec {engine.spec.fingerprint()})...")

@@ -103,6 +103,13 @@ def build_parser() -> argparse.ArgumentParser:
                          "fail@K / hang@K:S hit window dispatches, "
                          "corrupt:manifest tears manifest writes) — "
                          "chaos testing only")
+    ap.add_argument("--tracing", action="store_true",
+                    help="request-scoped tracing (obs/spans.py): the job "
+                         "gets a root stream.job span with one "
+                         "stream.window child per window (resumed windows "
+                         "show as cached spans) plus the engine's full "
+                         "per-request span tree — render with "
+                         "tools/trace_view.py")
     return ap
 
 
@@ -152,6 +159,7 @@ def main(argv=None) -> int:
                      or os.path.join(args.job_dir, "stream_ledger.jsonl")),
         keep_videos=True,
         faults=faults,
+        tracing=args.tracing,
     )
     prompts = [args.prompt, args.edit_prompt]
     print(f"[stream] warming programs (spec {engine.spec.fingerprint()})...")

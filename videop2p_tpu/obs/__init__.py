@@ -43,6 +43,16 @@ Pillars (ISSUEs 2–4):
     (no tensorflow import) + ``trace_window``: per-op-family device
     time, top-N ops, compute/collective overlap fraction and idle gaps
     mined into ``trace_analysis`` ledger events with ``.npz`` sidecars.
+  * :mod:`videop2p_tpu.obs.spans` — request-scoped distributed tracing
+    (ISSUE 14): 128-bit trace ids, ``span`` ledger events with wall-clock
+    anchored monotonic durations, W3C-style ``traceparent`` propagation
+    across the router→replica HTTP hop (``tools/trace_view.py`` joins
+    the ledgers into one causal tree).
+  * :mod:`videop2p_tpu.obs.slo` — declarative SLO specs evaluated into
+    ``slo_report`` events with per-objective error-budget burn, gated by
+    ``SLO_RULES`` in obs_diff.
+  * :mod:`videop2p_tpu.obs.prom` — Prometheus text exposition of the
+    serving ``/metrics`` records (``?format=prometheus``).
   * :mod:`videop2p_tpu.obs.comm` — distributed observability (ISSUE 5):
     collective-communication accounting of sharded programs
     (``comm_analysis`` events with per-kind counts/bytes + sharding
@@ -79,6 +89,8 @@ from videop2p_tpu.obs.history import (
     DEFAULT_RULES,
     FAULT_RULES,
     QUALITY_RULES,
+    SEGMENT_RULES,
+    SLO_RULES,
     TIMING_RULES,
     RegressionRule,
     RunHistory,
@@ -115,6 +127,28 @@ from videop2p_tpu.obs.telemetry import (
     sparkline,
     summarize_step_stats,
     telemetry_overhead_record,
+)
+from videop2p_tpu.obs.prom import (
+    engine_metrics_prometheus,
+    render_prometheus,
+    router_metrics_prometheus,
+)
+from videop2p_tpu.obs.slo import (
+    DEFAULT_SLOS,
+    SLO_REPORT_FIELDS,
+    SLOSpec,
+    emit_slo_reports,
+    evaluate_slos,
+    record_from_summaries,
+)
+from videop2p_tpu.obs.spans import (
+    SPAN_EVENT_FIELDS,
+    SPAN_SEGMENTS,
+    Tracer,
+    format_traceparent,
+    make_span_id,
+    make_trace_id,
+    parse_traceparent,
 )
 from videop2p_tpu.obs.timing import (
     EXECUTE_TIMING_FIELDS,
@@ -165,6 +199,24 @@ __all__ = [
     "COMM_RULES",
     "TIMING_RULES",
     "FAULT_RULES",
+    "SLO_RULES",
+    "SEGMENT_RULES",
+    "SPAN_EVENT_FIELDS",
+    "SPAN_SEGMENTS",
+    "Tracer",
+    "format_traceparent",
+    "make_span_id",
+    "make_trace_id",
+    "parse_traceparent",
+    "SLO_REPORT_FIELDS",
+    "SLOSpec",
+    "DEFAULT_SLOS",
+    "evaluate_slos",
+    "emit_slo_reports",
+    "record_from_summaries",
+    "render_prometheus",
+    "engine_metrics_prometheus",
+    "router_metrics_prometheus",
     "EXECUTE_TIMING_FIELDS",
     "LatencyReservoir",
     "latency_enabled",

@@ -33,6 +33,8 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
 from videop2p_tpu.obs.introspect import PROGRAM_METRICS
 from videop2p_tpu.obs.ledger import read_ledger
+from videop2p_tpu.obs.spans import SPAN_SEGMENTS
+from videop2p_tpu.obs.timing import percentile
 
 __all__ = [
     "RegressionRule",
@@ -42,6 +44,8 @@ __all__ = [
     "TIMING_RULES",
     "FAULT_RULES",
     "SEAM_RULES",
+    "SLO_RULES",
+    "SEGMENT_RULES",
     "split_runs",
     "extract_run",
     "evaluate_rules",
@@ -65,7 +69,11 @@ class RegressionRule:
     (cross-replica divergence scalars), ``"reliability"`` (serving-health
     summaries from ``serve_health`` events — error/shed rates, breaker
     trips), ``"stream"`` (streaming-job summaries from ``stream_health``
-    events — seam PSNRs, window failures). ``min_abs`` suppresses verdicts
+    events — seam PSNRs, window failures), ``"slo"`` (per-objective
+    compliance/budget-burn from ``slo_report`` events, obs/slo.py), or
+    ``"segment"`` (per-critical-path-segment latency percentiles
+    aggregated from ``span`` events — queue/resolve/dispatch/decode).
+    ``min_abs`` suppresses verdicts
     whose absolute delta is noise-sized (a 0.001 s phase doubling is not a
     regression). ``programs`` (labels for program/compile/dispatch kinds,
     phase names for phases) restricts the rule; None applies it everywhere.
@@ -182,6 +190,34 @@ SEAM_RULES: Tuple[RegressionRule, ...] = (
     RegressionRule("src_err_max", kind="stream", direction="nonzero"),
 )
 
+# SLO gates (ISSUE 14): obs/slo.py evaluates declarative objectives
+# (availability, served p99, deadline-miss rate, seam PSNR) into
+# `slo_report` events with a uniform `budget_burn` — the fraction of the
+# objective's error budget consumed (1.0 = budget exactly spent). Burn
+# GROWING by a quarter of the budget regresses; an objective FLIPPING
+# from compliant to non-compliant regresses regardless of magnitude
+# (compliant is 1.0/0.0, so the 0.5 floor means exactly "it flipped").
+# Self-compare stays clean: a 0-delta is never above the threshold.
+SLO_RULES: Tuple[RegressionRule, ...] = (
+    RegressionRule("budget_burn", kind="slo", threshold_pct=25.0,
+                   min_abs=0.25),
+    RegressionRule("compliant", kind="slo", direction="decrease",
+                   threshold_pct=0.0, min_abs=0.5),
+)
+
+# critical-path gates (ISSUE 14): per-segment latency percentiles
+# aggregated from request `span` events (queue vs resolve vs dispatch vs
+# decode, obs/spans.py SPAN_SEGMENTS). A segment's tail growing names
+# WHICH stage of the pipeline regressed, where the e2e TIMING_RULES only
+# say that something did. Floors mirror the timing rules' — CPU-test
+# micro-latencies stay out.
+SEGMENT_RULES: Tuple[RegressionRule, ...] = (
+    RegressionRule("p50_s", kind="segment", threshold_pct=25.0,
+                   min_abs=0.001),
+    RegressionRule("p99_s", kind="segment", threshold_pct=25.0,
+                   min_abs=0.002),
+)
+
 DEFAULT_RULES: Tuple[RegressionRule, ...] = (
     RegressionRule("flops", threshold_pct=10.0),
     RegressionRule("bytes_accessed", threshold_pct=15.0, min_abs=1 << 20),
@@ -190,7 +226,8 @@ DEFAULT_RULES: Tuple[RegressionRule, ...] = (
     RegressionRule("hlo_instructions", threshold_pct=25.0, min_abs=16),
     RegressionRule("seconds", kind="compile", threshold_pct=50.0, min_abs=1.0),
     RegressionRule("seconds", kind="phase", threshold_pct=25.0, min_abs=0.5),
-) + QUALITY_RULES + COMM_RULES + TIMING_RULES + FAULT_RULES + SEAM_RULES
+) + (QUALITY_RULES + COMM_RULES + TIMING_RULES + FAULT_RULES + SEAM_RULES
+     + SLO_RULES + SEGMENT_RULES)
 
 
 def split_runs(events: Iterable[Dict[str, Any]]) -> List[List[Dict[str, Any]]]:
@@ -242,7 +279,13 @@ def extract_run(events: Sequence[Dict[str, Any]],
         "reliability": {},
         # streaming section (ISSUE 12) — likewise empty pre-PR-12
         "stream": {},
+        # tracing sections (ISSUE 14) — likewise empty pre-PR-14 or
+        # with tracing off: per-critical-path-segment latency
+        # percentiles from span events, per-objective SLO reports
+        "segments": {},
+        "slo": {},
     }
+    seg_samples: Dict[str, List[float]] = {}
     for e in events:
         kind = e.get("event")
         if kind == "program_analysis":
@@ -383,6 +426,41 @@ def extract_run(events: Sequence[Dict[str, Any]],
             rec["divergence"][label] = max(
                 rec["divergence"].get(label, 0.0), val
             )
+        elif kind == "span":
+            # critical-path accumulation (ISSUE 14): spans whose name maps
+            # to a pipeline segment contribute their duration; finalized
+            # into per-segment percentiles after the scan
+            seg = SPAN_SEGMENTS.get(e.get("name"))
+            if seg is not None:
+                try:
+                    seg_samples.setdefault(seg, []).append(
+                        float(e.get("duration_s", 0.0))
+                    )
+                except (TypeError, ValueError):
+                    pass
+        elif kind == "slo_report":
+            # one objective per event (obs/slo.py); a later evaluation in
+            # the same run supersedes. `compliant` lands as 1.0/0.0 so the
+            # decrease rule sees the flip.
+            name = e.get("name") or "(unnamed)"
+            vals: Dict[str, float] = {}
+            for k, v in e.items():
+                if k in ("event", "t", "name", "section", "label",
+                         "field", "mode"):
+                    continue
+                if isinstance(v, bool):
+                    vals[k] = 1.0 if v else 0.0
+                elif isinstance(v, (int, float)):
+                    vals[k] = float(v)
+            rec["slo"][name] = vals
+    for seg, durations in sorted(seg_samples.items()):
+        rec["segments"][seg] = {
+            "count": float(len(durations)),
+            "p50_s": round(percentile(durations, 50), 6),
+            "p99_s": round(percentile(durations, 99), 6),
+            "max_s": round(max(durations), 6),
+            "total_s": round(sum(durations), 6),
+        }
     return rec
 
 
@@ -415,8 +493,10 @@ def _rule_values(record: Dict[str, Any], rule: RegressionRule) -> Dict[str, floa
                    for k, v in record.get("device_memory", {}).items()}
     elif rule.kind == "divergence":
         out = {k: float(v) for k, v in record.get("divergence", {}).items()}
-    elif rule.kind in ("timing", "trace", "reliability", "stream"):
-        for label, m in record.get(rule.kind, {}).items():
+    elif rule.kind in ("timing", "trace", "reliability", "stream", "slo",
+                       "segment"):
+        section = "segments" if rule.kind == "segment" else rule.kind
+        for label, m in record.get(section, {}).items():
             if rule.metric in m:
                 out[label] = float(m[rule.metric])
     if rule.programs is not None:
@@ -519,6 +599,13 @@ class RunHistory:
     def scan(cls, directory: str, pattern: str = "*.jsonl") -> "RunHistory":
         keyed = []
         for path in sorted(glob.glob(os.path.join(directory, pattern))):
+            # rotated segments (<stem>.N.jsonl, RunLedger(max_bytes=...))
+            # are read through their base ledger's chain — scanning them
+            # directly would double-count every run
+            root = path[:-len(".jsonl")] if path.endswith(".jsonl") else path
+            base, dot, idx = root.rpartition(".")
+            if dot and idx.isdigit() and os.path.exists(base + ".jsonl"):
+                continue
             try:
                 events = read_ledger(path)
                 mtime = os.path.getmtime(path)

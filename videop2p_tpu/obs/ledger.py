@@ -162,11 +162,23 @@ class RunLedger:
         meta: Optional[Dict[str, Any]] = None,
         device_info: bool = True,
         latency: bool = False,
+        max_bytes: Optional[int] = None,
     ):
         self.path = path
         self.run_id = run_id or uuid.uuid4().hex[:12]
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
         self._fh = open(path, "a", buffering=1)  # line-buffered: kill-safe
+        # size-aware rotation (ISSUE 14): streaming jobs append one JSONL
+        # without limit — with max_bytes set, a write that would cross the
+        # bound first shifts the file to <stem>.1.jsonl (older segments
+        # shift up) and the fresh file opens with a ledger_rotated marker.
+        # read_ledger() reads the chain back oldest-first.
+        self.max_bytes = int(max_bytes) if max_bytes else None
+        try:
+            self._bytes = os.path.getsize(path)
+        except OSError:
+            self._bytes = 0
+        self._rotations = 0
         self._lock = threading.Lock()
         self._t0 = time.perf_counter()
         self._closed = False
@@ -220,13 +232,59 @@ class RunLedger:
             line = json.dumps(rec, default=str)
         except (TypeError, ValueError):
             line = json.dumps({"event": "encode_error", "kind": kind})
+        data = line + "\n"
         with self._lock:
             if self._closed:
                 return
+            if (self.max_bytes is not None and self._bytes > 0
+                    and self._bytes + len(data) > self.max_bytes):
+                self._rotate_locked()
             try:
-                self._fh.write(line + "\n")
+                self._fh.write(data)
+                self._bytes += len(data)
             except (OSError, ValueError):
                 pass
+
+    def _rotate_locked(self) -> None:
+        """Shift the full file aside and reopen fresh (caller holds the
+        lock). ``<stem>.1.jsonl`` is the newest rotated segment; existing
+        segments shift up first, logrotate-style. The new file opens with
+        a ``ledger_rotated`` marker so readers (and humans) see the seam."""
+        try:
+            self._fh.close()
+        except OSError:
+            pass
+        stem = (self.path[:-len(".jsonl")]
+                if self.path.endswith(".jsonl") else self.path)
+        try:
+            n = 1
+            while os.path.exists(f"{stem}.{n}.jsonl"):
+                n += 1
+            for i in range(n - 1, 0, -1):
+                os.replace(f"{stem}.{i}.jsonl", f"{stem}.{i + 1}.jsonl")
+            os.replace(self.path, f"{stem}.1.jsonl")
+        except OSError:
+            pass
+        self._rotations += 1
+        rotated_bytes, self._bytes = self._bytes, 0
+        try:
+            self._fh = open(self.path, "a", buffering=1)
+        except OSError:
+            return  # writes degrade to the event() guard's silent drop
+        marker = {
+            "event": "ledger_rotated",
+            "t": round(time.perf_counter() - self._t0, 4),
+            "run_id": self.run_id,
+            "previous": f"{stem}.1.jsonl",
+            "rotated_bytes": rotated_bytes,
+            "index": self._rotations,
+        }
+        try:
+            data = json.dumps(marker) + "\n"
+            self._fh.write(data)
+            self._bytes += len(data)
+        except (OSError, ValueError):
+            pass
 
     def phase(self, name: str, seconds: float, **fields: Any) -> None:
         self.event("phase", name=name, seconds=round(float(seconds), 4), **fields)
@@ -288,17 +346,20 @@ class RunLedger:
         return self.latency or latency_enabled()
 
     def record_execute(self, program: str, dispatch_s: float,
-                       blocked_s: float) -> None:
+                       blocked_s: float,
+                       trace_id: Optional[str] = None) -> None:
         """Accumulate one dispatch's (dispatch-return, block-until-ready)
         latencies into the program's bounded reservoir (obs/timing.py).
-        Nothing is written until :meth:`flush_execute_timing` / close."""
+        ``trace_id`` (tracing on) links the reservoir's max/p99 exemplars
+        back to the offending trace. Nothing is written until
+        :meth:`flush_execute_timing` / close."""
         from videop2p_tpu.obs.timing import LatencyReservoir
 
         with self._timing_lock:
             res = self._timing.get(program)
             if res is None:
                 res = self._timing[program] = LatencyReservoir()
-        res.add(dispatch_s, blocked_s)
+        res.add(dispatch_s, blocked_s, trace_id)
 
     def execute_timing_summary(self) -> Dict[str, Dict[str, float]]:
         """Live per-program reservoir summaries WITHOUT writing events —
@@ -559,15 +620,26 @@ def instrumented_jit(fun, *, program: str, analyze: bool = True, **jit_kwargs):
 
 def read_ledger(path: str) -> List[Dict[str, Any]]:
     """Parse a ledger JSONL file back into event dicts (skips any torn
-    final line from a killed run)."""
+    final line from a killed run).
+
+    Rotation-aware: when ``RunLedger(max_bytes=...)`` rotated the file,
+    the predecessors ``<stem>.N.jsonl`` … ``<stem>.1.jsonl`` are read
+    first (oldest first) so ``split_runs``/``extract_run`` see the whole
+    run as one stream, ``ledger_rotated`` markers included."""
+    stem = path[:-len(".jsonl")] if path.endswith(".jsonl") else path
+    n = 1
+    while os.path.exists(f"{stem}.{n}.jsonl"):
+        n += 1
+    paths = [f"{stem}.{i}.jsonl" for i in range(n - 1, 0, -1)] + [path]
     events = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                events.append(json.loads(line))
-            except ValueError:
-                continue
+    for p in paths:
+        with open(p) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    events.append(json.loads(line))
+                except ValueError:
+                    continue
     return events

@@ -1,0 +1,171 @@
+"""Prometheus text exposition for the serving ``/metrics`` records.
+
+``/metrics`` on both the replica (``serve/http.py``) and the router
+(``serve/router.py``) serves a nested JSON record. This module renders
+that SAME record — no new counters, no second bookkeeping path — into
+the Prometheus text exposition format (version 0.0.4) so a stock scrape
+job can point at ``/metrics?format=prometheus`` and get gauges.
+
+Rendering rules (deterministic — output is fully sorted, so the golden
+test can pin it byte-for-byte):
+
+  * numeric scalars become gauges named ``videop2p_<path>`` where the
+    path is the underscore-joined key chain (``compile.total_s`` →
+    ``videop2p_compile_total_s``);
+  * the well-known fan-out sections become LABELED series instead of
+    key-mangled names: ``requests`` → ``videop2p_requests_total{status=}``,
+    ``tenants`` → ``videop2p_tenant_<field>{tenant=}``, ``programs`` →
+    ``videop2p_program_<field>{program=}``, ``replicas`` →
+    ``videop2p_replica_<field>{replica=}`` (with each replica's nested
+    ``requests`` as ``videop2p_replica_requests_total{replica=,status=}``);
+  * bools render as 1/0, non-finite floats as ``+Inf``/``-Inf``/``NaN``
+    (all legal in the exposition format), strings and None are skipped
+    (identity fields like fingerprints have no gauge meaning);
+  * every metric gets one ``# TYPE <name> gauge`` comment line.
+
+Stdlib only; the import-guard test walks this module.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = [
+    "render_prometheus",
+    "engine_metrics_prometheus",
+    "router_metrics_prometheus",
+]
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_PREFIX = "videop2p"
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+_LIST_DEPTH_CAP = 4  # defensive recursion bound on nested dicts
+
+
+def _metric_name(*parts: str) -> str:
+    joined = "_".join(p for p in parts if p)
+    return _NAME_RE.sub("_", joined)
+
+
+def _escape_label(value: str) -> str:
+    return (str(value).replace("\\", "\\\\")
+            .replace('"', '\\"').replace("\n", "\\n"))
+
+
+def _fmt(value: Any) -> Optional[str]:
+    """Exposition-format literal for a scalar, or None to skip it."""
+    if isinstance(value, bool):
+        return "1" if value else "0"
+    if isinstance(value, (int, float)):
+        f = float(value)
+        if math.isnan(f):
+            return "NaN"
+        if math.isinf(f):
+            return "+Inf" if f > 0 else "-Inf"
+        return format(f, ".10g")
+    return None
+
+
+class _Sink:
+    """Accumulates samples grouped by metric name for sorted rendering."""
+
+    def __init__(self) -> None:
+        self._series: Dict[str, List[Tuple[str, str]]] = {}
+
+    def put(self, name: str, value: Any,
+            labels: Optional[List[Tuple[str, str]]] = None) -> None:
+        text = _fmt(value)
+        if text is None:
+            return
+        label_str = ""
+        if labels:
+            inner = ",".join(f'{k}="{_escape_label(v)}"'
+                             for k, v in labels)
+            label_str = "{" + inner + "}"
+        self._series.setdefault(name, []).append((label_str, text))
+
+    def render(self) -> str:
+        lines: List[str] = []
+        for name in sorted(self._series):
+            lines.append(f"# TYPE {name} gauge")
+            for label_str, text in sorted(self._series[name]):
+                lines.append(f"{name}{label_str} {text}")
+        return "\n".join(lines) + "\n" if lines else ""
+
+
+def _flatten(sink: _Sink, prefix: str, value: Any,
+             labels: Optional[List[Tuple[str, str]]] = None,
+             depth: int = 0) -> None:
+    """Numeric leaves of a nested dict as ``<prefix>_<path>`` gauges."""
+    if isinstance(value, dict):
+        if depth >= _LIST_DEPTH_CAP:
+            return
+        for k in sorted(value):
+            _flatten(sink, _metric_name(prefix, str(k)), value[k],
+                     labels, depth + 1)
+    else:
+        sink.put(prefix, value, labels)
+
+
+def _put_status_counts(sink: _Sink, name: str, counts: Any,
+                       labels: Optional[List[Tuple[str, str]]] = None,
+                       ) -> None:
+    if not isinstance(counts, dict):
+        return
+    for status in sorted(counts):
+        sink.put(name, counts[status],
+                 (labels or []) + [("status", str(status))])
+
+
+def render_prometheus(metrics: Dict[str, Any], *,
+                      prefix: str = _PREFIX) -> str:
+    """The Prometheus text exposition of one ``/metrics`` JSON record."""
+    sink = _Sink()
+    for key in sorted(metrics or {}):
+        value = metrics[key]
+        if key == "requests":
+            _put_status_counts(
+                sink, _metric_name(prefix, "requests_total"), value)
+        elif key == "tenants" and isinstance(value, dict):
+            for tenant in sorted(value):
+                _flatten(sink, _metric_name(prefix, "tenant"),
+                         value[tenant], [("tenant", str(tenant))])
+        elif key == "programs" and isinstance(value, dict):
+            for program in sorted(value):
+                _flatten(sink, _metric_name(prefix, "program"),
+                         value[program], [("program", str(program))])
+        elif key == "replicas" and isinstance(value, dict):
+            for replica in sorted(value):
+                rec = value[replica]
+                if not isinstance(rec, dict):
+                    continue
+                rlabels = [("replica", str(replica))]
+                for rk in sorted(rec):
+                    rv = rec[rk]
+                    if rk == "requests":
+                        _put_status_counts(
+                            sink,
+                            _metric_name(prefix, "replica_requests_total"),
+                            rv, rlabels)
+                    elif not isinstance(rv, dict):
+                        sink.put(_metric_name(prefix, "replica", rk),
+                                 rv, rlabels)
+                    # deeper replica sections (scheduler, store, ...) are
+                    # scraped from the replica's own endpoint, not
+                    # re-exported through the router
+        else:
+            _flatten(sink, _metric_name(prefix, key), value)
+    return sink.render()
+
+
+def engine_metrics_prometheus(metrics: Dict[str, Any]) -> str:
+    """Exposition text for a replica engine's ``metrics()`` record."""
+    return render_prometheus(metrics)
+
+
+def router_metrics_prometheus(metrics: Dict[str, Any]) -> str:
+    """Exposition text for the router's fleet ``metrics()`` record."""
+    return render_prometheus(metrics)

@@ -20,7 +20,11 @@ the repo's equivalent of Prompt-to-Prompt's ``show_cross_attention``
   * a "Where time goes" section (``obs/timing.py`` / ``obs/trace.py``
     events): per-program execute-latency distributions and mined
     device-trace breakdowns — ``trace`` events whose directory still
-    exists on disk are auto-mined at render time.
+    exists on disk are auto-mined at render time;
+  * a request critical-path + SLO section (``obs/spans.py`` /
+    ``obs/slo.py`` events, ISSUE 14): per-segment queue/resolve/
+    dispatch/decode percentiles over the run's spans and the
+    per-objective error-budget-burn table.
 
 ``tools/edit_report.py`` is the CLI wrapper. The ledger is parsed with a
 local JSONL reader (not ``obs.ledger``) so this module's import closure
@@ -328,6 +332,58 @@ def _stream_section(events) -> str:
     return out
 
 
+def _trace_slo_section(events) -> str:
+    """Request tracing + SLOs (obs/spans.py + obs/slo.py, ISSUE 14):
+    per-segment critical-path percentiles over the run's spans, and the
+    per-objective SLO compliance/budget-burn table. Empty for
+    tracing-off, SLO-off ledgers."""
+    from videop2p_tpu.obs.spans import SPAN_SEGMENTS
+    from videop2p_tpu.obs.timing import percentile
+
+    out = ""
+    seg_samples: Dict[str, List[float]] = {}
+    n_spans = 0
+    trace_ids = set()
+    for e in events:
+        if e.get("event") != "span":
+            continue
+        n_spans += 1
+        trace_ids.add(e.get("trace_id"))
+        seg = SPAN_SEGMENTS.get(e.get("name"))
+        if seg is not None:
+            try:
+                seg_samples.setdefault(seg, []).append(
+                    float(e.get("duration_s") or 0.0))
+            except (TypeError, ValueError):
+                pass
+    if seg_samples:
+        rows = [[seg, len(vals),
+                 f"{percentile(vals, 50) * 1e3:.2f}",
+                 f"{percentile(vals, 99) * 1e3:.2f}",
+                 f"{max(vals) * 1e3:.2f}"]
+                for seg, vals in sorted(seg_samples.items())]
+        out += ("<h2>Request critical path</h2>"
+                "<p class=meta>obs/spans.py — per-segment latency of "
+                f"{len(trace_ids)} trace(s) / {n_spans} spans (gated by "
+                "SEGMENT_RULES; join ledgers with tools/trace_view.py)."
+                "</p>"
+                + _table(rows, ["segment", "spans", "p50 (ms)",
+                                "p99 (ms)", "max (ms)"]))
+    slos = [e for e in events if e.get("event") == "slo_report"]
+    if slos:
+        rows = [[e.get("name"), e.get("mode"), _fmt(e.get("target")),
+                 _fmt(e.get("actual")), _fmt(e.get("budget_burn")),
+                 "ok" if e.get("compliant") else "VIOLATED"]
+                for e in slos]
+        out += ("<h2>SLOs</h2>"
+                "<p class=meta>obs/slo.py — per-objective error-budget "
+                "burn (burn ≤ 1.0 is compliant; obs_diff SLO_RULES gate "
+                "burn growth across runs).</p>"
+                + _table(rows, ["objective", "mode", "target", "actual",
+                                "burn", "verdict"]))
+    return out
+
+
 def _null_text_section(events) -> str:
     ev = next((e for e in events if e.get("event") == "telemetry"
                and e.get("loss_curve")), None)
@@ -561,6 +617,7 @@ def render_report(events: Sequence[Dict[str, Any]],
         _mask_section(events, sidecar),
         _null_text_section(events),
         _stream_section(events),
+        _trace_slo_section(events),
         _comm_section(events),
         _time_section(events),
         _verdict_section(events),

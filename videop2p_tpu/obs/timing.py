@@ -71,6 +71,12 @@ EXECUTE_TIMING_FIELDS = (
     "blocked_p99_s",
     "blocked_max_s",
     "dispatch_fraction",
+    # exemplars (ISSUE 14): the trace ids behind the exact max and the
+    # nearest-rank p99 sample — a timing regression in obs_diff links
+    # straight to an offending trace in trace_view. Always present;
+    # None when tracing was off (the common case).
+    "max_trace_id",
+    "p99_trace_id",
 )
 
 
@@ -115,24 +121,32 @@ class LatencyReservoir:
         self.count = 0
         self.dispatch_max = 0.0
         self.blocked_max = 0.0
-        self._samples: List[Tuple[float, float]] = []
+        self.max_trace_id: Optional[str] = None
+        self._samples: List[Tuple[float, float, Optional[str]]] = []
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
 
-    def add(self, dispatch_s: float, blocked_s: float) -> None:
+    def add(self, dispatch_s: float, blocked_s: float,
+            trace_id: Optional[str] = None) -> None:
         d, b = float(dispatch_s), float(blocked_s)
         with self._lock:
             self.count += 1
             self.dispatch_max = max(self.dispatch_max, d)
-            self.blocked_max = max(self.blocked_max, b)
+            if b >= self.blocked_max:
+                # exact exemplar: the max is tracked outside the sample,
+                # so its trace link must be too (a sampled-away spike
+                # still names its trace)
+                self.blocked_max = b
+                if trace_id is not None:
+                    self.max_trace_id = trace_id
             if len(self._samples) < self.capacity:
-                self._samples.append((d, b))
+                self._samples.append((d, b, trace_id))
             else:
                 j = self._rng.randrange(self.count)
                 if j < self.capacity:
-                    self._samples[j] = (d, b)
+                    self._samples[j] = (d, b, trace_id)
 
-    def samples(self) -> List[Tuple[float, float]]:
+    def samples(self) -> List[Tuple[float, float, Optional[str]]]:
         with self._lock:
             return list(self._samples)
 
@@ -146,8 +160,9 @@ class LatencyReservoir:
             out.count = self.count
             out.dispatch_max = self.dispatch_max * factor
             out.blocked_max = self.blocked_max * factor
-            out._samples = [(d * factor, b * factor)
-                            for d, b in self._samples]
+            out.max_trace_id = self.max_trace_id
+            out._samples = [(d * factor, b * factor, t)
+                            for d, b, t in self._samples]
         return out
 
     def summary(self) -> Optional[Dict[str, float]]:
@@ -156,10 +171,17 @@ class LatencyReservoir:
         with self._lock:
             if not self._samples:
                 return None
-            dispatch = [d for d, _ in self._samples]
-            blocked = [b for _, b in self._samples]
+            dispatch = [d for d, _, _ in self._samples]
+            blocked = [b for _, b, _ in self._samples]
             count, sampled = self.count, len(self._samples)
             d_max, b_max = self.dispatch_max, self.blocked_max
+            max_trace = self.max_trace_id
+            # the p99 exemplar: the trace behind the nearest-rank p99
+            # blocked sample (an actually-observed latency, like the
+            # percentile itself)
+            by_blocked = sorted(self._samples, key=lambda s: s[1])
+            rank = math.ceil(99 * len(by_blocked) / 100.0)
+            p99_trace = by_blocked[min(max(rank, 1), len(by_blocked)) - 1][2]
         b_p50 = percentile(blocked, 50)
         d_p50 = percentile(dispatch, 50)
         return {
@@ -177,6 +199,8 @@ class LatencyReservoir:
             # and execution proceeded in the background; ~1 = the host
             # blocked for the full execution inside the dispatch itself
             "dispatch_fraction": round(d_p50 / b_p50, 4) if b_p50 > 0 else 1.0,
+            "max_trace_id": max_trace,
+            "p99_trace_id": p99_trace,
         }
 
 

@@ -61,9 +61,10 @@ class EngineClient:
         return min(max(delay, 0.0), self.backoff_cap_s)
 
     def _request(self, path: str, payload: Optional[Dict] = None,
-                 timeout_s: Optional[float] = None) -> Dict[str, Any]:
+                 timeout_s: Optional[float] = None,
+                 headers: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
         data = None
-        headers = {}
+        headers = dict(headers or {})
         if payload is not None:
             data = json.dumps(payload).encode()
             headers["Content-Type"] = "application/json"
@@ -100,9 +101,26 @@ class EngineClient:
     def metrics(self) -> Dict[str, Any]:
         return self._request("/metrics")
 
-    def submit(self, request: Dict[str, Any]) -> str:
-        """Submit an edit request dict (EditRequest fields); returns the id."""
-        return self._request("/v1/edits", payload=request)["id"]
+    def metrics_prometheus(self) -> str:
+        """The ``/metrics?format=prometheus`` text exposition, verbatim."""
+        req = urllib.request.Request(
+            self.base_url + "/metrics?format=prometheus"
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+            return resp.read().decode("utf-8")
+
+    def submit(self, request: Dict[str, Any], *,
+               traceparent: Optional[str] = None) -> str:
+        """Submit an edit request dict (EditRequest fields); returns the id.
+
+        ``traceparent`` (ISSUE 14) rides as an HTTP header — never in the
+        JSON body, which the server's strict ``_REQUEST_FIELDS`` schema
+        would reject — so a caller's trace continues server-side and the
+        two ledgers join on one trace id in ``tools/trace_view.py``.
+        """
+        headers = {"traceparent": traceparent} if traceparent else None
+        return self._request("/v1/edits", payload=request,
+                             headers=headers)["id"]
 
     def poll(self, rid: str) -> Dict[str, Any]:
         return self._request(f"/v1/edits/{rid}")

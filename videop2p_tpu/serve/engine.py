@@ -88,6 +88,12 @@ from videop2p_tpu.serve.faults import (
     RetryPolicy,
     is_transient,
 )
+from videop2p_tpu.obs.spans import (
+    Tracer,
+    make_span_id,
+    make_trace_id,
+    parse_traceparent,
+)
 from videop2p_tpu.serve.programs import ProgramSet, ProgramSpec
 from videop2p_tpu.serve.store import InversionStore
 
@@ -237,6 +243,14 @@ class EditEngine:
         breaker_threshold: int = 3,
         breaker_open_s: float = 5.0,
         faults: Optional[FaultPlan] = None,
+        # observability knobs (ISSUE 14): `tracing` records the request
+        # lifecycle as span ledger events (admit → queue → resolve →
+        # batch/dispatch → decode) joined across processes via the
+        # traceparent header; `slo` evaluates DEFAULT_SLOS into
+        # slo_report events at close. Both OFF by default — the off path
+        # is pinned bit-exact with zero added dispatches.
+        tracing: bool = False,
+        slo: bool = False,
     ):
         from videop2p_tpu.cli.common import make_run_ledger
 
@@ -273,9 +287,13 @@ class EditEngine:
             enable=True, latency=True, set_latency_env=False,
             meta={"cli": "serve", "spec": dict(spec.resolved().__dict__),
                   "scheduler": self.scheduler.name,
-                  "faults": getattr(self.faults, "spec", None)},
+                  "faults": getattr(self.faults, "spec", None),
+                  "tracing": bool(tracing)},
             mesh=spec.mesh,
         )
+        self.tracer = Tracer(self.ledger, enabled=tracing)
+        self._tracing = self.tracer.enabled
+        self._slo = bool(slo)
         self.fault_log: List[Dict[str, Any]] = []
         self.counters: Dict[str, int] = {
             "shed": 0, "rejected_unavailable": 0, "retries": 0,
@@ -335,8 +353,14 @@ class EditEngine:
         self.ledger.event("serve_warm", **info)
         return info
 
-    def submit(self, request: EditRequest) -> str:
+    def submit(self, request: EditRequest, *,
+               traceparent: Optional[str] = None) -> str:
         """Enqueue one request; returns its id immediately.
+
+        ``traceparent`` (tracing on) joins this request to an inbound
+        distributed trace — the HTTP layer passes the header through; a
+        missing/malformed value starts a fresh trace. With tracing off it
+        is ignored entirely.
 
         Fast-fail surfaces (each one machine-readable at the HTTP layer):
         a closed engine or an OPEN circuit breaker raises
@@ -389,6 +413,16 @@ class EditEngine:
                         if k != "frames"},
             "compile_events_before": len(self.ledger.compile_seconds),
         }
+        if self._tracing:
+            # the request's root-span identity: join the inbound trace
+            # (router proxy / client) or start fresh. `_wall_ns` anchors
+            # every retroactive span of this request to the wall clock.
+            parsed = parse_traceparent(traceparent)
+            trace_id, parent = parsed if parsed else (make_trace_id(), None)
+            rec["trace_id"] = trace_id
+            rec["span_id"] = make_span_id()
+            rec["_span_parent"] = parent
+            rec["_wall_ns"] = time.time_ns()
         with self._req_lock:
             if self._inflight >= self.max_queue:
                 depth = self._inflight
@@ -570,7 +604,24 @@ class EditEngine:
         for rid in pending:
             self._fail_status(rid, "engine_closed",
                               "engine closed before completion")
-        self.ledger.event("serve_health", **self.health_record())
+        health = self.health_record()
+        if self._slo:
+            # evaluate the declarative objectives over the LIVE summaries
+            # (obs/slo.py) — one slo_report event per objective, before
+            # the health summary so both land in the same run record
+            try:
+                from videop2p_tpu.obs.slo import (
+                    emit_slo_reports,
+                    record_from_summaries,
+                )
+
+                emit_slo_reports(self.ledger, record_from_summaries(
+                    health=health,
+                    timing=self.ledger.execute_timing_summary(),
+                ))
+            except Exception:  # noqa: BLE001 — obs never blocks shutdown
+                pass
+        self.ledger.event("serve_health", **health)
         self.ledger.event("serve_shutdown", requests=len(self._requests))
         self.ledger.close()
 
@@ -771,17 +822,28 @@ class EditEngine:
             seq = rec0.get("seq", 0)
             deadline_at = rec0.get("deadline_at")
             tenant = rec0.get("tenant", "default")
+            tid = rec0.get("trace_id") if self._tracing else None
+            root_span = rec0.get("span_id")
+            wall0 = rec0.get("_wall_ns")
         # queue wait: submit → the worker picking the request up. The
         # continuous-vs-drain acceptance compares this reservoir's mean
         # across scheduling policies on the same trace.
         queue_wait_s = max(t0 - submitted, 0.0) if submitted else 0.0
         self.ledger.record_execute("serve_queue_wait", queue_wait_s,
-                                   queue_wait_s)
+                                   queue_wait_s, tid)
         with self._counter_lock:
             self._qw_sum += queue_wait_s
             self._qw_count += 1
         self._update(rid, status="resolving",
                      queue_wait_s=round(queue_wait_s, 4))
+        if tid:
+            # the queue segment spans submit → here; its start IS the
+            # request's wall anchor
+            self.tracer.emit(
+                "serve.queue", trace_id=tid, span_id=make_span_id(),
+                parent_id=root_span, wall_ns=wall0,
+                duration_s=queue_wait_s, rid=rid,
+            )
         try:
             ps = self.programs
             steps = int(request.steps) if request.steps else self.spec.steps
@@ -860,10 +922,21 @@ class EditEngine:
                 check_subset_windows(ctx_edit, cached, positions, steps)
             args = (cached, cond_all, uncond, ctx_edit, anchor)
             dt = time.perf_counter() - t0
-            self.ledger.record_execute("serve_resolve", dt, dt)
+            self.ledger.record_execute("serve_resolve", dt, dt, tid)
             self._update(rid, store_hit=source in ("memory", "disk"),
                          store_source=source, store_key=key, steps=steps,
                          resolve_s=round(dt, 4))
+            if tid:
+                # resolve started at worker pickup (t0): anchor = submit
+                # wall + the monotonic offset since submit
+                self.tracer.emit(
+                    "serve.resolve", trace_id=tid, span_id=make_span_id(),
+                    parent_id=root_span,
+                    wall_ns=(wall0 + int((t0 - submitted) * 1e9)
+                             if wall0 is not None and submitted else None),
+                    duration_s=dt, rid=rid, store_source=source,
+                    steps=steps,
+                )
             return _Prepared(
                 rid=rid, args=args, steps=steps,
                 compat=compat_key(args, extra=(
@@ -1001,7 +1074,9 @@ class EditEngine:
             # success: the breaker's half-open probe (or plain traffic)
             self.breaker.record_success()
             dt = time.perf_counter() - t0
-            self.ledger.record_execute("serve_dispatch", dt, dt)
+            tid0 = (self._emit_dispatch_spans(live, t0, dt)
+                    if self._tracing else None)
+            self.ledger.record_execute("serve_dispatch", dt, dt, tid0)
             for p, (videos, src_err) in zip(plan.items, outs):
                 if p.rid in failed:
                     continue
@@ -1009,12 +1084,52 @@ class EditEngine:
                              float(np.asarray(jax.device_get(src_err))), dt)
             return
 
+    def _emit_dispatch_spans(self, live, t0: float,
+                             dt: float) -> Optional[str]:
+        """The batch's span structure: a span belongs to ONE trace but a
+        batch serves many, so one ``serve.batch`` span lands under the
+        FIRST member's trace carrying a fresh ``batch_id`` plus the member
+        rids, and every member request gets its own ``serve.dispatch``
+        child span carrying the same ``batch_id`` as the cross-trace link.
+        Returns the first member's trace_id (the dispatch reservoir's
+        exemplar)."""
+        batch_id = make_span_id()
+        members = [p.rid for p in live]
+        with self._req_lock:
+            recs = {p.rid: dict(self._requests.get(p.rid) or {})
+                    for p in live}
+        first_tid = None
+        for p in live:
+            rec = recs.get(p.rid) or {}
+            tid = rec.get("trace_id")
+            if not tid:
+                continue
+            wall0, submitted = rec.get("_wall_ns"), rec.get("submitted_s")
+            wall = (wall0 + int((t0 - submitted) * 1e9)
+                    if wall0 is not None and submitted else None)
+            if first_tid is None:
+                first_tid = tid
+                self.tracer.emit(
+                    "serve.batch", trace_id=tid, span_id=batch_id,
+                    parent_id=rec.get("span_id"), wall_ns=wall,
+                    duration_s=dt, batch_id=batch_id,
+                    batch_size=len(live), members=members,
+                )
+            self.tracer.emit(
+                "serve.dispatch", trace_id=tid, span_id=make_span_id(),
+                parent_id=rec.get("span_id"), wall_ns=wall, duration_s=dt,
+                rid=p.rid, batch_id=batch_id, batch_size=len(live),
+            )
+        return first_tid
+
     def _finish(self, rid: str, videos: np.ndarray, src_err: float,
                 dispatch_s: float) -> None:
         from videop2p_tpu.utils.video_io import save_video_gif
 
         rec = self.poll(rid)
         req = rec["request"]
+        tid = rec.get("trace_id") if self._tracing else None
+        t_dec0 = time.perf_counter() if tid else None
         req_dir = os.path.join(self.out_dir, rid)
         os.makedirs(req_dir, exist_ok=True)
         inversion_gif = os.path.join(req_dir, "inversion.gif")
@@ -1024,7 +1139,16 @@ class EditEngine:
         if self.keep_videos:
             self._videos[rid] = videos
         total = time.perf_counter() - rec["submitted_s"]
-        self.ledger.record_execute("serve_request_e2e", total, total)
+        if tid:
+            wall0 = rec.get("_wall_ns")
+            self.tracer.emit(
+                "serve.decode", trace_id=tid, span_id=make_span_id(),
+                parent_id=rec.get("span_id"),
+                wall_ns=(wall0 + int((t_dec0 - rec["submitted_s"]) * 1e9)
+                         if wall0 is not None else None),
+                duration_s=time.perf_counter() - t_dec0, rid=rid,
+            )
+        self.ledger.record_execute("serve_request_e2e", total, total, tid)
         compile_events = (len(self.ledger.compile_seconds)
                           - rec.get("compile_events_before", 0))
         self._terminalize(
@@ -1050,9 +1174,25 @@ class EditEngine:
             rec.update(fields)
             self._inflight -= 1
             tenant = rec.get("tenant", "default")
+            tid = rec.get("trace_id") if self._tracing else None
+            root_span = rec.get("span_id")
+            parent = rec.get("_span_parent")
+            wall0 = rec.get("_wall_ns")
+            submitted = rec.get("submitted_s")
         self._tcount(tenant, {"done": "done", "error": "errors",
                               "deadline_exceeded": "deadline_exceeded",
                               "engine_closed": "engine_closed"}[status])
+        if tid:
+            # the request's ROOT span closes on EVERY terminal transition
+            # (done / error / deadline_exceeded / engine_closed) — a trace
+            # with no root is a trace that never terminated
+            self.tracer.emit(
+                "serve.request", trace_id=tid, span_id=root_span,
+                parent_id=parent, wall_ns=wall0,
+                duration_s=(time.perf_counter() - submitted
+                            if submitted else 0.0),
+                status=status, rid=rid, tenant=tenant,
+            )
         return True
 
     def _fail_status(self, rid: str, status: str, message: str,

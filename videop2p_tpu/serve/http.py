@@ -40,6 +40,10 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
+from videop2p_tpu.obs.prom import (
+    PROMETHEUS_CONTENT_TYPE,
+    engine_metrics_prometheus,
+)
 from videop2p_tpu.serve.engine import EditEngine, EditRequest
 from videop2p_tpu.serve.faults import EngineUnavailable, QueueFull
 
@@ -68,6 +72,15 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _send_text(self, code: int, text: str,
+                   content_type: str = "text/plain; charset=utf-8") -> None:
+        body = text.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def _error(self, code: int, message: str, *,
                headers: Optional[Dict[str, str]] = None,
                **extra: Any) -> None:
@@ -92,7 +105,15 @@ class _Handler(BaseHTTPRequestHandler):
                 })
                 return
             if url.path == "/metrics":
-                self._send(200, self.engine.metrics())
+                fmt = parse_qs(url.query).get("format", [""])[0]
+                if fmt == "prometheus":
+                    self._send_text(
+                        200,
+                        engine_metrics_prometheus(self.engine.metrics()),
+                        content_type=PROMETHEUS_CONTENT_TYPE,
+                    )
+                else:
+                    self._send(200, self.engine.metrics())
                 return
             m = _EDIT_PATH.match(url.path)
             if m:
@@ -121,7 +142,12 @@ class _Handler(BaseHTTPRequestHandler):
             try:
                 body = json.loads(self.rfile.read(length) or b"{}")
                 request = EditRequest.from_dict(body)
-                rid = self.engine.submit(request)
+                # the traceparent rides as a header, never in the JSON
+                # body (from_dict's strict schema would reject it) — a
+                # tracing-off engine ignores it entirely
+                rid = self.engine.submit(
+                    request, traceparent=self.headers.get("traceparent")
+                )
             except QueueFull as e:
                 # load shed: the bounded admit queue is full — the depth in
                 # the body lets clients reason about how overloaded we are

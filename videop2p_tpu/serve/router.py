@@ -41,6 +41,17 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from videop2p_tpu.obs.prom import (
+    PROMETHEUS_CONTENT_TYPE,
+    router_metrics_prometheus,
+)
+from videop2p_tpu.obs.spans import (
+    Tracer,
+    format_traceparent,
+    make_span_id,
+    make_trace_id,
+    parse_traceparent,
+)
 from videop2p_tpu.serve.client import EngineClient
 from videop2p_tpu.serve.faults import EngineUnavailable, RetryPolicy
 
@@ -138,6 +149,7 @@ class Router:
         probe_ttl_s: float = 0.5,
         ledger: Any = None,
         ledger_path: Optional[str] = None,
+        tracing: bool = False,
     ):
         urls = [str(u) for u in replica_urls if str(u).strip()]
         if not urls:
@@ -155,8 +167,16 @@ class Router:
 
             self.ledger = RunLedger(
                 ledger_path,
-                meta={"cli": "router", "replicas": urls},
+                meta={"cli": "router", "replicas": urls,
+                      "tracing": bool(tracing)},
             )
+        # request-scoped tracing (ISSUE 14): the router records a
+        # `router.submit` span per routed request and FORWARDS a child
+        # traceparent to the chosen replica, so the router ledger and N
+        # replica ledgers join into one causal tree in trace_view. Off
+        # (the default, or no ledger): zero per-request overhead beyond
+        # one boolean check, and no header is forwarded.
+        self.tracer = Tracer(self.ledger, enabled=tracing)
         self._rid_map: Dict[str, _ReplicaView] = {}
         self._lock = threading.Lock()
         self.counters: Dict[str, int] = {
@@ -200,12 +220,32 @@ class Router:
 
     # ---- request surface -------------------------------------------------
 
-    def submit(self, body: Dict[str, Any]) -> Dict[str, Any]:
+    def submit(self, body: Dict[str, Any], *,
+               traceparent: Optional[str] = None) -> Dict[str, Any]:
         """Route one submit; returns ``{"id", "replica"}``. Raises
         :class:`RouterBadRequest` on a 4xx answer (the caller's fault) and
         :class:`EngineUnavailable` when no replica accepts after the
-        deterministic retry schedule."""
+        deterministic retry schedule.
+
+        With tracing on, the inbound ``traceparent`` (or a fresh trace)
+        becomes a ``router.submit`` span in the router ledger, and its
+        span id is forwarded as the CHILD traceparent to whichever
+        replica accepts — the replica's ``serve.request`` root parents
+        under the router's span in the joined tree.
+        """
         self._count("submitted")
+        tid: Optional[str] = None
+        span_id: Optional[str] = None
+        parent: Optional[str] = None
+        child_tp: Optional[str] = None
+        t0 = wall0 = 0.0
+        if self.tracer.enabled:
+            parsed = parse_traceparent(traceparent) if traceparent else None
+            tid, parent = parsed if parsed else (make_trace_id(), None)
+            span_id = make_span_id()
+            child_tp = format_traceparent(tid, span_id)
+            wall0 = time.time_ns()
+            t0 = time.perf_counter()
         attempt = 0
         last_error = "no replicas"
         while True:
@@ -213,7 +253,8 @@ class Router:
             avoided_ids = {id(v) for v in avoided}
             for view in candidates:
                 try:
-                    rid = view.client.submit(dict(body))
+                    rid = view.client.submit(dict(body),
+                                             traceparent=child_tp)
                 except RuntimeError as e:
                     msg = str(e)
                     if "HTTP 400" in msg or "HTTP 404" in msg:
@@ -236,7 +277,15 @@ class Router:
                 view.routed += 1
                 view.consecutive_failures = 0
                 if self.ledger is not None:
-                    self.ledger.record_execute("router_submit", 0.0, 0.0)
+                    dt = time.perf_counter() - t0 if tid else 0.0
+                    self.ledger.record_execute("router_submit", dt, dt, tid)
+                if tid:
+                    self.tracer.emit(
+                        "router.submit", trace_id=tid, span_id=span_id,
+                        parent_id=parent, wall_ns=wall0,
+                        duration_s=time.perf_counter() - t0,
+                        rid=rid, replica=view.name, attempts=attempt + 1,
+                    )
                 return {"id": rid, "replica": view.name}
             if attempt >= self.retry.max_retries:
                 break
@@ -245,6 +294,13 @@ class Router:
             attempt += 1
             time.sleep(delay)
         self._count("rejected")
+        if tid:
+            self.tracer.emit(
+                "router.submit", trace_id=tid, span_id=span_id,
+                parent_id=parent, wall_ns=wall0,
+                duration_s=time.perf_counter() - t0,
+                status="rejected", attempts=attempt + 1,
+            )
         raise EngineUnavailable(
             f"no replica accepted the request after {attempt + 1} pass(es) "
             f"(last: {last_error})",
@@ -409,6 +465,16 @@ def _make_handler(router: Router):
                    **extra: Any) -> None:
             self._send(code, {"error": message, **extra}, headers=headers)
 
+        def _send_text(self, code: int, text: str,
+                       content_type: str = "text/plain; charset=utf-8"
+                       ) -> None:
+            body = text.encode("utf-8")
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
         def do_GET(self) -> None:  # noqa: N802 — handler contract
             url = urlparse(self.path)
             try:
@@ -416,7 +482,15 @@ def _make_handler(router: Router):
                     self._send(200, router.healthz())
                     return
                 if url.path == "/metrics":
-                    self._send(200, router.metrics())
+                    fmt = parse_qs(url.query).get("format", [""])[0]
+                    if fmt == "prometheus":
+                        self._send_text(
+                            200,
+                            router_metrics_prometheus(router.metrics()),
+                            content_type=PROMETHEUS_CONTENT_TYPE,
+                        )
+                    else:
+                        self._send(200, router.metrics())
                     return
                 m = _EDIT_PATH.match(url.path)
                 if m:
@@ -444,7 +518,9 @@ def _make_handler(router: Router):
                 length = int(self.headers.get("Content-Length", "0"))
                 try:
                     body = json.loads(self.rfile.read(length) or b"{}")
-                    out = router.submit(body)
+                    out = router.submit(
+                        body, traceparent=self.headers.get("traceparent")
+                    )
                 except RouterBadRequest as e:
                     self._error(400, str(e))
                     return

@@ -54,6 +54,11 @@ from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from videop2p_tpu.obs.spans import (
+    format_traceparent,
+    make_span_id,
+    make_trace_id,
+)
 from videop2p_tpu.stream.manifest import JobManifest
 from videop2p_tpu.stream.windows import (
     Window,
@@ -203,6 +208,18 @@ def run_stream_job(
         manifest.entries = {}
 
     ledger = getattr(engine, "ledger", None)
+    # job-scoped tracing (ISSUE 14): when the ENGINE traces, the job gets
+    # a root `stream.job` span and one `stream.window` child per window
+    # spanning submit→harvest — resumed windows appear as zero-duration
+    # "cached" spans, so a resumed job's trace shows exactly what was
+    # recomputed. Tracing off: tracer.emit is inert, nothing changes.
+    tracer = getattr(engine, "tracer", None)
+    tracing = tracer is not None and getattr(tracer, "enabled", False)
+    trace_id = make_trace_id() if tracing else None
+    job_span = make_span_id() if tracing else None
+    job_wall = time.time_ns() if tracing else None
+    job_t0 = time.perf_counter()
+    wspans: Dict[int, tuple] = {}  # index -> (span_id, wall_ns, t0)
     keys = {
         w.index: window_key(spec_fp, frames[w.start:w.stop], prompts,
                             seed=seed, extra=identity["request"])
@@ -221,6 +238,13 @@ def run_stream_job(
         if cached is not None:
             outputs[w.index] = cached
             skipped += 1
+            if tracing:
+                tracer.emit(
+                    "stream.window", trace_id=trace_id,
+                    span_id=make_span_id(), parent_id=job_span,
+                    duration_s=0.0, status="cached", index=w.index,
+                    cached=True,
+                )
 
     counters = {
         "done": 0, "passthrough": 0, "failed": 0, "retries": 0,
@@ -248,9 +272,17 @@ def run_stream_job(
             seed=int(seed),
             **request_kwargs,
         )
+        tp = None
+        if tracing:
+            # one span per window across ALL its attempts: keep the first
+            # submit's anchor so the span covers submit→harvest
+            if w.index not in wspans:
+                wspans[w.index] = (make_span_id(), time.time_ns(),
+                                   time.perf_counter())
+            tp = format_traceparent(trace_id, wspans[w.index][0])
         for attempt in range(max(int(window_retries), 0) + 1):
             try:
-                return engine.submit(req)
+                return engine.submit(req, traceparent=tp)
             except Exception as e:  # noqa: BLE001 — refusal is data, not a crash
                 counters["retries"] += 1
                 if ledger is not None:
@@ -288,8 +320,20 @@ def run_stream_job(
         if ledger is not None:
             ledger.event("stream_window", **record)
             if window_s is not None:
-                ledger.record_execute("stream_window_e2e", float(window_s),
-                                      float(window_s))
+                ledger.record_execute(
+                    "stream_window_e2e", float(window_s), float(window_s),
+                    trace_id if tracing else None,
+                )
+        if tracing:
+            sp = wspans.get(w.index)
+            if sp is not None:
+                span_id, wall_w, t0_w = sp
+                tracer.emit(
+                    "stream.window", trace_id=trace_id, span_id=span_id,
+                    parent_id=job_span, wall_ns=wall_w,
+                    duration_s=time.perf_counter() - t0_w, status=status,
+                    index=w.index, attempts=attempts,
+                )
 
     def _passthrough(w: Window, attempts: int, error: str) -> None:
         counters["failed"] += 1
@@ -390,5 +434,14 @@ def run_stream_job(
         for s in seams:
             ledger.event("stream_seam", **s)
         ledger.event("stream_health", **health)
+    if tracing:
+        tracer.emit(
+            "stream.job", trace_id=trace_id, span_id=job_span,
+            parent_id=None, wall_ns=job_wall,
+            duration_s=time.perf_counter() - job_t0,
+            status="interrupted" if interrupted else "ok",
+            windows=len(plan), skipped=skipped,
+            passthrough=counters["passthrough"],
+        )
     return StreamJobResult(video=video01, health=health, manifest=manifest,
                            seams=seams, windows=window_records)
