@@ -871,6 +871,109 @@ def record_streaming_scaling(rec, *, analyses=None, timeout_s=None) -> None:
     rec.record("streaming_scaling_backend", "cpu-static")
 
 
+# the per-UNet-call cost evidence (ISSUE 15): quantization shrinks the
+# bytes a call must move (argument_bytes IS the weight footprint — int8
+# weights enter the program as 1-byte inputs and upcast inside the
+# trace), reuse shrinks the flops a K-step span must spend (shallow
+# steps skip the down/mid stack). Both claims come from loop-free
+# straight-line unit programs (tools/cpu_cost_capture.py
+# ``unet_unit_{fp,w8,w8a8}`` / ``reuse_unit_<K>``) because XLA's static
+# cost analysis counts scan bodies once and lax.cond both-branches —
+# the fused edit scan can't testify for either knob.
+PER_CALL_COST_KS = (2, 5)
+# schema-stable per-record field set (tests/test_bench_guard.py pins it)
+PER_CALL_COST_FIELDS = (
+    "program", "quant_mode", "reuse_schedule", "calls", "flops",
+    "bytes_accessed", "argument_bytes", "peak_hbm_bytes",
+    "flops_vs_full", "bytes_vs_full", "argument_bytes_vs_full",
+)
+
+
+def per_call_cost_records(analyses):
+    """Per-variant UNet-call cost records from the ``unet_unit_*`` /
+    ``reuse_unit_<K>`` unit analyses: each row normalizes its static
+    flops / bytes-accessed / argument-bytes against the SAME number of
+    full-precision full-path calls (``calls`` × ``unet_unit_fp`` for
+    flops/bytes; 1× for argument_bytes — weights are passed once however
+    many steps read them). ``unet_unit_fp`` missing → the vs-full ratios
+    are None; no unit analyses at all → ``[]``. Pure + CPU-tested so the
+    record shape cannot drift; every record carries exactly
+    ``PER_CALL_COST_FIELDS``."""
+    fp = (analyses or {}).get("unet_unit_fp")
+    fp_flops = float(fp["flops"]) if isinstance(fp, dict) and fp.get(
+        "flops") else None
+    fp_bytes = float(fp["bytes_accessed"]) if isinstance(fp, dict) and fp.get(
+        "bytes_accessed") else None
+    fp_args = float(fp["argument_bytes"]) if isinstance(fp, dict) and fp.get(
+        "argument_bytes") else None
+
+    def row(name, a, *, quant_mode, reuse_schedule, calls):
+        flops = a.get("flops")
+        nbytes = a.get("bytes_accessed")
+        args = a.get("argument_bytes")
+        return {
+            "program": name,
+            "quant_mode": quant_mode,
+            "reuse_schedule": reuse_schedule,
+            "calls": calls,
+            "flops": flops,
+            "bytes_accessed": nbytes,
+            "argument_bytes": args,
+            "peak_hbm_bytes": a.get("peak_hbm_bytes"),
+            "flops_vs_full": (
+                round(float(flops) / (calls * fp_flops), 3)
+                if flops and fp_flops else None
+            ),
+            "bytes_vs_full": (
+                round(float(nbytes) / (calls * fp_bytes), 3)
+                if nbytes and fp_bytes else None
+            ),
+            "argument_bytes_vs_full": (
+                round(float(args) / fp_args, 3)
+                if args and fp_args else None
+            ),
+        }
+
+    records = []
+    for name, qm in (("unet_unit_fp", "off"), ("unet_unit_w8", "w8"),
+                     ("unet_unit_w8a8", "w8a8")):
+        a = (analyses or {}).get(name)
+        if isinstance(a, dict):
+            records.append(row(name, a, quant_mode=qm,
+                               reuse_schedule="off", calls=1))
+    reuse = []
+    for name, a in (analyses or {}).items():
+        if (isinstance(a, dict) and name.startswith("reuse_unit_")
+                and name[len("reuse_unit_"):].isdigit()):
+            reuse.append((int(name[len("reuse_unit_"):]), name, a))
+    for k, name, a in sorted(reuse):
+        records.append(row(name, a, quant_mode="off",
+                           reuse_schedule=f"uniform:{k}", calls=k))
+    return records
+
+
+def record_per_call_cost(rec, *, timeout_s=None, ks=PER_CALL_COST_KS) -> None:
+    """Capture the per-call quant/reuse unit analyses (CPU subprocess —
+    static flop/byte counts are backend-independent) and persist the
+    normalized cost records (``per_call_cost``). Best-effort: an
+    incomplete capture records nothing rather than killing the round."""
+    timeout_s = timeout_s if timeout_s is not None else float(os.environ.get(
+        "VIDEOP2P_BENCH_CPU_ANALYSIS_TIMEOUT", "900"))
+    programs = ["unet_unit_fp", "unet_unit_w8", "unet_unit_w8a8"]
+    programs += [f"reuse_unit_{int(k)}" for k in ks]
+    analyses = collect_cpu_analysis(
+        BENCH_FRAMES, BENCH_STEPS, timeout_s=timeout_s, programs=programs,
+    )
+    records = per_call_cost_records(analyses)
+    if not records:
+        print("[bench] per-call cost unit capture incomplete "
+              f"(have {sorted(analyses)}) — skipping the record",
+              file=sys.stderr, flush=True)
+        return
+    rec.record("per_call_cost", records)
+    rec.record("per_call_cost_backend", "cpu-static")
+
+
 def build_fast_edit_working_point(*, num_frames: int = 8, num_steps: int = 50,
                                   frame_attention: str = "auto",
                                   group_norm: str = "auto",
@@ -994,7 +1097,7 @@ def build_fast_edit_working_point(*, num_frames: int = 8, num_steps: int = 50,
 
 def run_step_frontier(fn, params, sched, cond, uncond, x0, *,
                       base_steps=50, step_counts=(50, 20, 8), timed=True,
-                      guidance_scale=7.5):
+                      guidance_scale=7.5, variants=()):
     """The latency-vs-quality step frontier (ISSUE 8 / ROADMAP item 3):
     from ONE ``base_steps`` captured inversion, run the cached fast edit at
     every requested step count via exact timestep-subset schedules
@@ -1005,8 +1108,22 @@ def run_step_frontier(fn, params, sched, cond, uncond, x0, *,
     step count (``src_err`` must read 0.0 — stream 0 is the trajectory's
     x_0 by construction, steps or no steps).
 
+    ``variants``: extra ``(quant_mode, reuse_schedule)`` rows (ISSUE 15) —
+    each runs the SAME cached edit at ``base_steps`` with int8
+    weight-quantized params (``models/convert.quantize_unet_params``,
+    dequantized inside the trace) and/or a DeepCache reuse schedule
+    (``pipelines/reuse.py``), scored against the full-precision full-step
+    edit exactly like the subset rows. ``quant_mode`` here is limited to
+    ``off``/``w8`` (the a8 activation seam needs the model rebuilt with
+    ``act_quant_fn`` — that evidence comes from the ``unet_unit_w8a8``
+    cost unit instead). The source replay must stay exact under BOTH
+    knobs: stream 0 is replayed from the cached trajectory, never
+    recomputed, so ``src_err`` reads 0.0 regardless of eps precision.
+
     Returns ``(records, outputs)`` — one JSON-safe record per step count
-    (non-finite metric values become null) in base-steps-first order.
+    (non-finite metric values become null) in base-steps-first order,
+    variant rows last; every record carries ``quant_mode`` and
+    ``reuse_schedule`` (``"off"`` on the plain step rows).
     """
     import math
 
@@ -1089,6 +1206,8 @@ def run_step_frontier(fn, params, sched, cond, uncond, x0, *,
         rec = {
             "steps": steps,
             "base_steps": base_steps,
+            "quant_mode": "off",
+            "reuse_schedule": "off",
             "edit_s": edit_s,
             "src_err": float(jnp.max(jnp.abs(
                 out[0].astype(jnp.float32) - x0_f
@@ -1119,11 +1238,74 @@ def run_step_frontier(fn, params, sched, cond, uncond, x0, *,
             rec["mask_coverage"] = None
         records.append(rec)
         outputs[steps] = out
+
+    for qm, rs in variants:
+        qm, rs = str(qm), str(rs)
+        if qm not in ("off", "w8"):
+            raise ValueError(
+                f"frontier quant_mode must be 'off' or 'w8', got {qm!r} "
+                "(w8a8 needs the model rebuilt with act_quant_fn — see the "
+                "unet_unit_w8a8 cost unit)"
+            )
+        if qm == "off" and rs == "off":
+            continue  # identical to the base row
+        p_v = params
+        if qm == "w8":
+            from videop2p_tpu.models.convert import quantize_unet_params
+            p_v = quantize_unet_params(params, mode=qm)
+        prog = jax.jit(
+            lambda p, xt, cch, _rs=(None if rs == "off" else rs):
+            edit_sample(
+                fn, p, sched, xt, cond, uncond,
+                num_inference_steps=base_steps,
+                guidance_scale=guidance_scale, ctx=ctx_base,
+                source_uses_cfg=False, cached_source=cch,
+                reuse_schedule=_rs,
+            )
+        )
+        out = hard_block(prog(p_v, x_t, cached))
+        edit_s = None
+        if timed:
+            t0 = time.perf_counter()
+            hard_block(prog(p_v, x_t * (1.0 + 1e-6), cached))
+            edit_s = round(time.perf_counter() - t0, 3)
+        edit = out[1].astype(jnp.float32)
+        rec = {
+            "steps": base_steps,
+            "base_steps": base_steps,
+            "quant_mode": qm,
+            "reuse_schedule": rs,
+            "edit_s": edit_s,
+            "src_err": float(jnp.max(jnp.abs(
+                out[0].astype(jnp.float32) - x0_f
+            ))),
+            "edit_adjacent_psnr_db": _jf(jnp.mean(
+                adjacent_frame_psnr(edit, data_range=span)
+            )),
+            "vs_full_psnr_db": _jf(psnr(edit, base_edit, data_range=span)),
+            "vs_full_ssim": _jf(ssim(edit, base_edit, data_range=span), 4),
+            "speedup_vs_full": (
+                round(base_wall / edit_s, 2)
+                if timed and base_wall and edit_s else None
+            ),
+        }
+        if mask is not None:
+            bg = (1.0 - mask.astype(jnp.float32))[..., None]
+            rec["background_psnr_db"] = _jf(
+                masked_psnr(edit, x0_f, bg, data_range=span)
+            )
+            rec["mask_coverage"] = _jf(jnp.mean(mask.astype(jnp.float32)), 4)
+        else:
+            rec["background_psnr_db"] = None
+            rec["mask_coverage"] = None
+        records.append(rec)
+        outputs[f"{qm}+{rs}"] = out
     return records, outputs
 
 
 def collect_step_frontier(*, timeout_s=900.0, tiny=True, frames=2,
-                          base_steps=50, step_counts=(50, 20, 8)):
+                          base_steps=50, step_counts=(50, 20, 8),
+                          variants=()):
     """Run ``tools/step_frontier.py`` in a CPU SUBPROCESS (same isolation
     rationale as :func:`collect_cpu_analysis`: this is the backend-down
     path, and the parent's jax may hold a poisoned backend init) and parse
@@ -1133,6 +1315,8 @@ def collect_step_frontier(*, timeout_s=900.0, tiny=True, frames=2,
     cmd = [sys.executable, os.path.join(repo, "tools", "step_frontier.py"),
            "--frames", str(frames), "--base_steps", str(base_steps),
            "--steps", ",".join(str(s) for s in step_counts)]
+    if variants:
+        cmd += ["--variants", ",".join(f"{qm}+{rs}" for qm, rs in variants)]
     if tiny:
         cmd.append("--tiny")
     env = dict(os.environ)
@@ -1347,7 +1531,14 @@ def record_cpu_only_evidence(repo_dir=None) -> None:
     # flops and store bytes per window — reuses the capture above (it
     # already holds e2e_cached, the per-window program)
     record_streaming_scaling(rec, analyses=analyses)
-    frontier = collect_step_frontier(timeout_s=timeout_s, tiny=True)
+    # the per-call cost evidence (ISSUE 15): quantized weight-footprint
+    # and reuse flop-fraction from loop-free unit programs, plus the
+    # quant/reuse variant rows on the executed tiny frontier below
+    record_per_call_cost(rec, timeout_s=timeout_s)
+    frontier = collect_step_frontier(
+        timeout_s=timeout_s, tiny=True,
+        variants=(("w8", "off"), ("off", "uniform:2"), ("w8", "uniform:2")),
+    )
     if frontier:
         rec.record("latency_quality_frontier", frontier)
         rec.record("latency_quality_frontier_backend", "cpu-tiny")

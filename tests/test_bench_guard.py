@@ -140,6 +140,9 @@ def test_cpu_only_evidence_records_analyses_and_verdicts(
     assert bd["latency_quality_frontier"] == frontier
     assert bd["latency_quality_frontier_backend"] == "cpu-tiny"
     assert "null_text_flops_reduction_amortized" not in bd
+    # the per-call cost record skips quietly too: the stubbed capture has
+    # no unet_unit_*/reuse_unit_* programs (ISSUE 15)
+    assert "per_call_cost" not in bd
     v = bd["analysis_verdicts"]
     assert v["baseline"] == "bench_details.json"
     assert v["compared_programs"] == ["e2e_cached"]
@@ -252,17 +255,23 @@ def test_step_frontier_tool_end_to_end_tiny(bench):
     records = bench.collect_step_frontier(
         timeout_s=560.0, tiny=True, frames=2,
         base_steps=50, step_counts=(50, 20, 8),
+        variants=(("w8", "uniform:2"),),
     )
-    assert [r["steps"] for r in records] == [50, 20, 8]
+    assert [r["steps"] for r in records] == [50, 20, 8, 50]
     for r in records:
         assert r["base_steps"] == 50
         assert r["src_err"] == 0.0, r          # replay exact at any count
         assert r["backend"] == "cpu" and r["tiny"] is True
         assert r["edit_s"] is not None and r["edit_s"] > 0
-    for r in records[1:]:  # the subset rows score against the full edit
+    for r in records[1:]:  # subset+variant rows score against the full edit
         assert isinstance(r["vs_full_psnr_db"], float)
         assert isinstance(r["vs_full_ssim"], float)
         assert r["speedup_vs_full"] is not None
+    # the ISSUE 15 variant row: quantized + reuse at full steps, replay
+    # still exact (asserted above), knobs recorded on every row
+    assert [(r["quant_mode"], r["reuse_schedule"]) for r in records] == [
+        ("off", "off"), ("off", "off"), ("off", "off"), ("w8", "uniform:2"),
+    ]
 
 
 @pytest.mark.slow
@@ -1148,6 +1157,55 @@ def test_frame_scaling_record_schema(bench):
     assert bench.tp_pairing_record({}) is None
     assert bench.tp_pairing_record({"tp_unit_gspmd": {"all_reduce_bytes": 1,
                                                       "shards": 8}}) is None
+
+
+def test_per_call_cost_record_schema(bench):
+    """ISSUE 15: the per-UNet-call cost records are schema-pinned — every
+    row carries exactly PER_CALL_COST_FIELDS, quant rows normalize against
+    ONE fp call, reuse rows against K fp calls for flops/bytes but ONE for
+    argument bytes (weights are passed once however many steps read them),
+    a missing fp unit yields None ratios, and no unit analyses yield []."""
+    analyses = {
+        "unet_unit_fp": {"flops": 1000, "bytes_accessed": 2000,
+                         "argument_bytes": 400, "peak_hbm_bytes": 50},
+        "unet_unit_w8": {"flops": 1010, "bytes_accessed": 1900,
+                         "argument_bytes": 100, "peak_hbm_bytes": 40},
+        "unet_unit_w8a8": {"flops": 1100, "bytes_accessed": 2100,
+                           "argument_bytes": 100, "peak_hbm_bytes": 40},
+        "reuse_unit_2": {"flops": 1600, "bytes_accessed": 3400,
+                         "argument_bytes": 410, "peak_hbm_bytes": 60},
+        "reuse_unit_5": {"flops": 3750, "bytes_accessed": 8000,
+                         "argument_bytes": 430, "peak_hbm_bytes": 65},
+        "reuse_unit_x": {"flops": 1},   # malformed suffix: ignored
+        "e2e_cached": {"flops": 9},     # not a per-call unit: ignored
+    }
+    records = bench.per_call_cost_records(analyses)
+    assert [r["program"] for r in records] == [
+        "unet_unit_fp", "unet_unit_w8", "unet_unit_w8a8",
+        "reuse_unit_2", "reuse_unit_5",
+    ]
+    for r in records:
+        assert set(r) == set(bench.PER_CALL_COST_FIELDS), r
+    by = {r["program"]: r for r in records}
+    assert by["unet_unit_fp"]["flops_vs_full"] == 1.0
+    assert by["unet_unit_fp"]["calls"] == 1
+    assert by["unet_unit_w8"]["quant_mode"] == "w8"
+    assert by["unet_unit_w8"]["argument_bytes_vs_full"] == 0.25
+    assert by["unet_unit_w8a8"]["quant_mode"] == "w8a8"
+    assert by["reuse_unit_2"]["reuse_schedule"] == "uniform:2"
+    assert by["reuse_unit_2"]["calls"] == 2
+    assert by["reuse_unit_2"]["flops_vs_full"] == 0.8    # 1600 / (2*1000)
+    assert by["reuse_unit_5"]["flops_vs_full"] == 0.75   # 3750 / (5*1000)
+    assert by["reuse_unit_5"]["bytes_vs_full"] == 0.8    # 8000 / (5*2000)
+    assert by["reuse_unit_5"]["argument_bytes_vs_full"] == round(430 / 400, 3)
+    # fp unit missing → ratios None but rows still land, shape stable
+    partial = bench.per_call_cost_records(
+        {k: v for k, v in analyses.items() if k != "unet_unit_fp"}
+    )
+    assert all(r["flops_vs_full"] is None for r in partial)
+    assert all(set(r) == set(bench.PER_CALL_COST_FIELDS) for r in partial)
+    assert bench.per_call_cost_records({}) == []
+    assert bench.per_call_cost_records(None) == []
 
 
 @pytest.mark.slow

@@ -1,0 +1,345 @@
+"""Quantized inference + cross-step feature reuse tests (ISSUE 15).
+
+Two per-UNet-call cost levers, pinned at their contracts:
+
+  * weight quantization (models/quant.py + models/convert.py
+    ``quantize_unet_params``) — int8 storage with per-output-channel
+    symmetric scales, round-trip error bounded by half a quantization
+    step per channel, the first/last-layer precision islands untouched,
+    and ``mode="off"`` the identity (the bit-exact pin);
+  * cross-step deep-feature reuse (pipelines/reuse.py + the
+    ``deep_mode`` seam in models/unet.py) — schedule grammar, the
+    off-path byte-identical, ``uniform:K`` ONE compiled program (the
+    schedule is a static per-step boolean in the scan's xs, never a
+    second trace), and the cached source replay EXACT under both knobs
+    (stream 0 is replayed from the captured trajectory, not recomputed —
+    eps precision cannot touch it);
+  * the quality observatory gate: quant/reuse quality metrics ride the
+    same ``quality`` ledger event QUALITY_RULES diff, so a PSNR drop
+    regresses a run exactly like a perf metric growing.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from videop2p_tpu.models.quant import (
+    QUANT_MODES,
+    QuantizedTensor,
+    SKIP_MODULES,
+    dequantize_tree,
+    fake_quant_act,
+    has_quantized,
+    quant_weight_dtype,
+    quantize_tree,
+    quantize_weight,
+    validate_quant_mode,
+)
+from videop2p_tpu.pipelines.reuse import (
+    parse_reuse_schedule,
+    reuse_label,
+    reuse_skip_fraction,
+    validate_reuse_schedule,
+)
+
+STEPS = 5
+SHAPE = (1, 2, 8, 8, 4)  # (B, F, h, w, C)
+
+
+# ------------------------------------------------------ weight quant --
+
+
+def test_quantize_weight_roundtrip_error_bound():
+    """Symmetric per-output-channel int8: the dequantized kernel is within
+    half a quantization step of the original IN EVERY CHANNEL (scale =
+    absmax/127, rounding error <= scale/2), and the full symmetric range
+    is used without the asymmetric -128 code."""
+    key = jax.random.key(0)
+    # per-channel magnitudes spanning 3 orders so one shared scale would fail
+    w = jax.random.normal(key, (3, 3, 16, 8)) * jnp.logspace(
+        -2, 1, 8
+    )[None, None, None, :]
+    q = quantize_weight(w)
+    assert isinstance(q, QuantizedTensor)
+    assert q.qvalue.dtype == jnp.int8 and q.qvalue.shape == w.shape
+    assert q.scale.shape == (1, 1, 1, 8)
+    assert int(jnp.min(q.qvalue)) >= -127  # symmetric: -128 never emitted
+    err = jnp.abs(q.dequantize() - w)
+    bound = q.scale * 0.5 * (1 + 1e-6)
+    assert bool(jnp.all(err <= bound))
+    # the quantization is not a no-op (real rounding happened)
+    assert float(jnp.max(err)) > 0.0
+
+
+def test_quantize_weight_scale_is_per_channel():
+    """A channel's scale is ITS absmax/127 — a hot channel cannot inflate
+    a quiet channel's quantization step (the point of per-output-channel
+    over per-tensor)."""
+    w = jnp.stack([jnp.linspace(-1.0, 1.0, 8),
+                   jnp.linspace(-100.0, 100.0, 8)], axis=-1)  # (8, 2)
+    q = quantize_weight(w)
+    np.testing.assert_allclose(
+        np.asarray(q.scale).ravel(), [1.0 / 127.0, 100.0 / 127.0], rtol=1e-6
+    )
+
+
+def test_fake_quant_act_bounded_and_type_preserving():
+    x = jax.random.normal(jax.random.key(1), (4, 7)).astype(jnp.bfloat16)
+    y = fake_quant_act(x)
+    assert y.dtype == x.dtype
+    xf = x.astype(jnp.float32)
+    step = float(jnp.max(jnp.abs(xf))) / 127.0
+    assert float(jnp.max(jnp.abs(y.astype(jnp.float32) - xf))) <= step
+    # non-float inputs (timestep indices riding a tree) pass through
+    ints = jnp.arange(4)
+    assert fake_quant_act(ints) is ints
+
+
+def test_quantize_tree_skips_precision_islands():
+    """Only matmul kernels outside SKIP_MODULES quantize; biases, norms
+    and the conv_in/conv_out/time_embedding islands stay full precision
+    (Q-Diffusion first/last-layer practice)."""
+    tree = {
+        "conv_in": {"kernel": jnp.ones((3, 3, 4, 8))},
+        "time_embedding": {"dense": {"kernel": jnp.ones((8, 32))}},
+        "down_blocks_0": {
+            "to_q": {"kernel": jnp.ones((8, 8)), "bias": jnp.zeros((8,))},
+            "norm": {"scale": jnp.ones((8,))},
+        },
+        "conv_out": {"kernel": jnp.ones((3, 3, 8, 4))},
+    }
+    qt = quantize_tree(tree)
+    assert isinstance(qt["down_blocks_0"]["to_q"]["kernel"], QuantizedTensor)
+    assert not isinstance(qt["conv_in"]["kernel"], QuantizedTensor)
+    assert not isinstance(qt["conv_out"]["kernel"], QuantizedTensor)
+    assert not isinstance(qt["time_embedding"]["dense"]["kernel"],
+                          QuantizedTensor)
+    assert not isinstance(qt["down_blocks_0"]["to_q"]["bias"],
+                          QuantizedTensor)
+    assert has_quantized(qt) and not has_quantized(tree)
+    back = dequantize_tree(qt)
+    assert not has_quantized(back)
+    np.testing.assert_allclose(
+        np.asarray(back["down_blocks_0"]["to_q"]["kernel"]),
+        np.asarray(tree["down_blocks_0"]["to_q"]["kernel"]), atol=1e-2
+    )
+
+
+def test_quantize_unet_params_modes_and_wrapper():
+    from videop2p_tpu.models.convert import quantize_unet_params
+
+    tree = {"params": {"blk": {"attn": {"kernel": jnp.ones((8, 8))}}},
+            "stats": {"x": jnp.zeros(())}}
+    # off is the identity — the pinned bit-exact path
+    assert quantize_unet_params(tree, mode="off") is tree
+    q = quantize_unet_params(tree, mode="w8")
+    assert isinstance(q["params"]["blk"]["attn"]["kernel"], QuantizedTensor)
+    assert q["stats"] is tree["stats"]  # sibling collections untouched
+    # bare inner tree works too
+    assert has_quantized(quantize_unet_params(tree["params"], mode="w8a8"))
+    with pytest.raises(ValueError, match="quant_mode"):
+        quantize_unet_params(tree, mode="int4")
+    assert validate_quant_mode(None) == "off"
+    assert set(QUANT_MODES) == {"off", "w8", "w8a8"}
+    assert quant_weight_dtype() == jnp.int8
+    assert "conv_in" in SKIP_MODULES and "conv_out" in SKIP_MODULES
+
+
+# --------------------------------------------------- reuse schedules --
+
+
+def test_parse_reuse_schedule_grammar():
+    assert parse_reuse_schedule(None, 5) is None
+    assert parse_reuse_schedule("off", 5) is None
+    assert parse_reuse_schedule("uniform:2", 5) == (
+        True, False, True, False, True)
+    assert parse_reuse_schedule("uniform:1", 3) == (True, True, True)
+    assert parse_reuse_schedule("custom:0,3", 5) == (
+        True, False, False, True, False)
+    assert validate_reuse_schedule("", 5) == "off"
+    assert validate_reuse_schedule("uniform:4", 5) == "uniform:4"
+
+
+def test_parse_reuse_schedule_rejects_malformed():
+    for bad, msg in [
+        ("uniform:x", "integer K"),
+        ("uniform:0", ">= 1"),
+        ("custom:", "at least one"),
+        ("custom:1,3", "start at 0"),
+        ("custom:0,2,2", "strictly increasing"),
+        ("custom:0,9", "outside"),
+        ("every_other", "not 'off'"),
+    ]:
+        with pytest.raises(ValueError, match=msg):
+            parse_reuse_schedule(bad, 5)
+    with pytest.raises(ValueError, match="num_steps"):
+        parse_reuse_schedule("uniform:2", 0)
+
+
+def test_reuse_skip_fraction_and_label():
+    assert reuse_skip_fraction(None) == 0.0
+    assert reuse_skip_fraction(parse_reuse_schedule("uniform:2", 10)) == 0.5
+    assert reuse_skip_fraction(parse_reuse_schedule("uniform:5", 10)) == 0.8
+    assert reuse_label("off") == "" and reuse_label(None) == ""
+    assert reuse_label("uniform:2") == "uniform2"
+    assert reuse_label("custom:0,3") == "custom0_3"
+
+
+# ------------------------------------------- tiny-model end-to-end --
+
+
+@pytest.fixture(scope="module")
+def sched():
+    from videop2p_tpu.core import DDIMScheduler
+
+    return DDIMScheduler.create_sd()
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    from videop2p_tpu.models import UNet3DConditionModel, UNet3DConfig
+    from videop2p_tpu.pipelines import make_unet_fn
+
+    cfg = UNet3DConfig.tiny()
+    model = UNet3DConditionModel(config=cfg)
+    sample = jax.random.normal(jax.random.key(0), SHAPE)
+    text = jax.random.normal(jax.random.key(1),
+                             (1, 77, cfg.cross_attention_dim))
+    params = jax.jit(model.init)(jax.random.key(2), sample,
+                                 jnp.asarray(10), text)
+    return make_unet_fn(model), params, cfg
+
+
+@pytest.fixture(scope="module")
+def cached_edit(sched, tiny):
+    """One captured inversion shared by the knob tests, plus the
+    full-precision reuse-off edit output they all score against."""
+    from videop2p_tpu.pipelines import ddim_inversion_captured, edit_sample
+
+    fn, params, cfg = tiny
+    x0 = 0.5 * jax.random.normal(jax.random.key(3), SHAPE)
+    cond = jax.random.normal(jax.random.key(4),
+                             (2, 77, cfg.cross_attention_dim))
+    uncond = jnp.zeros((77, cfg.cross_attention_dim))
+    traj, cached = jax.jit(
+        lambda p, x: ddim_inversion_captured(
+            fn, p, sched, x, cond[:1], num_inference_steps=STEPS,
+            cross_len=0, self_window=(0, 0),
+        )
+    )(params, x0)
+
+    def run(p, *, reuse=None):
+        return jax.jit(
+            lambda pp, xt, c: edit_sample(
+                fn, pp, sched, xt, cond, uncond,
+                num_inference_steps=STEPS, source_uses_cfg=False,
+                cached_source=c, reuse_schedule=reuse,
+            )
+        )(p, traj[-1], cached)
+
+    return run, params, x0, run(params)
+
+
+@pytest.mark.slow
+def test_reuse_off_values_are_bit_identical(cached_edit):
+    """The off pin: reuse_schedule=None and "off" take the byte-identical
+    scan body — same program, same bits out."""
+    run, params, x0, base = cached_edit
+    np.testing.assert_array_equal(np.asarray(run(params, reuse="off")),
+                                  np.asarray(base))
+
+
+@pytest.mark.slow
+def test_reuse_uniform_one_program_replay_exact_and_differs(cached_edit,
+                                                            tmp_path):
+    """uniform:2 stays ONE compiled program (the schedule is a static
+    boolean lane in the scan's xs + a lax.cond in the body — exactly one
+    ledger compile event for the whole edit), the source stream still
+    replays EXACTLY (stream 0 is read from the captured trajectory, the
+    shallow eps never touches it), and the edit stream genuinely changes
+    (the shallow steps really ran the reuse path)."""
+    from videop2p_tpu.obs import RunLedger, read_ledger
+
+    run, params, x0, base = cached_edit
+    path = str(tmp_path / "reuse_ledger.jsonl")
+    with RunLedger(path, device_info=False):
+        out = run(params, reuse="uniform:2")
+    compiles = [e for e in read_ledger(path) if e["event"] == "compile"]
+    assert len(compiles) == 1, compiles
+    np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(x0[0]))
+    assert not np.array_equal(np.asarray(out[1]), np.asarray(base[1]))
+    assert np.isfinite(np.asarray(out)).all()
+
+
+@pytest.mark.slow
+def test_w8_edit_quality_band_and_exact_replay(cached_edit, tiny):
+    """int8 weights through the SAME edit program (make_unet_fn
+    dequantizes inside the trace): the quantized edit stays within a PSNR
+    band of the full-precision edit — degraded, not destroyed — and the
+    cached source replay is still EXACT (quantization perturbs eps, eps
+    never touches the replayed stream)."""
+    from videop2p_tpu.models.convert import quantize_unet_params
+    from videop2p_tpu.obs.quality import psnr
+
+    run, params, x0, base = cached_edit
+    qp = quantize_unet_params(params, mode="w8")
+    assert has_quantized(qp)
+    out = run(qp)
+    np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(x0[0]))
+    edit, ref = np.asarray(out[1]), np.asarray(base[1])
+    assert not np.array_equal(edit, ref)  # quantization really engaged
+    span = float(np.max(ref) - np.min(ref))
+    band_db = float(psnr(jnp.asarray(edit), jnp.asarray(ref),
+                         data_range=span))
+    assert band_db > 15.0, f"w8 edit fell out of the quality band: {band_db} dB"
+
+
+@pytest.mark.slow
+def test_quant_and_reuse_stack_with_exact_replay(cached_edit):
+    """Both knobs together: the cheapest configuration still replays the
+    source exactly and produces a finite, distinct edit."""
+    from videop2p_tpu.models.convert import quantize_unet_params
+
+    run, params, x0, base = cached_edit
+    out = run(quantize_unet_params(params, mode="w8"), reuse="uniform:2")
+    np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(x0[0]))
+    assert np.isfinite(np.asarray(out)).all()
+    assert not np.array_equal(np.asarray(out[1]), np.asarray(base[1]))
+
+
+# ------------------------------------------------ the quality gate --
+
+
+def test_obs_diff_gates_quant_reuse_quality(tmp_path, capsys):
+    """The observatory acceptance: quant/reuse quality metrics land as a
+    ``quality`` ledger event and obs_diff's QUALITY_RULES gate them —
+    self-compare exits 0, an injected reconstruction-PSNR drop exits 1."""
+    import importlib.util
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "obs_diff_under_quant_test", os.path.join(repo, "tools", "obs_diff.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    from videop2p_tpu.obs import RunLedger
+
+    def write(path, run_id, recon_db):
+        led = RunLedger(str(path), run_id=run_id, device_info=False)
+        led.event("quality", recon_psnr=recon_db, background_psnr=31.0,
+                  recon_ssim=0.95, quant_mode="w8",
+                  reuse_schedule="uniform:2")
+        led.close()
+
+    a = tmp_path / "a.jsonl"
+    b = tmp_path / "b.jsonl"
+    write(a, "base", 28.0)
+    write(b, "quantized_drop", 20.0)  # -28% recon PSNR: past the 5% gate
+    assert mod.main(["obs_diff.py", str(a), str(a)]) == 0
+    assert mod.main(["obs_diff.py", str(a), str(b)]) == 1
+    out = capsys.readouterr().out
+    assert "recon_psnr" in out

@@ -414,7 +414,8 @@ def engine(tmp_path_factory):
         keep_videos=True,
     )
     eng.warm(("a rabbit is jumping", "a origami rabbit is jumping"),
-             batch_sizes=(2,), step_buckets=(1,))
+             batch_sizes=(2,), step_buckets=(1,),
+             reuse_schedules=("uniform:2",))
     yield eng
     eng.close()
 
@@ -513,6 +514,40 @@ def test_engine_rejects_unwarmed_steps_with_warm_list(engine):
     assert engine.programs.warmed["steps"] == [1, 2]
 
 
+def test_engine_reuse_and_quant_admission(engine):
+    """ISSUE 15 satellites: a warmed reuse schedule serves (store hit,
+    source replay still exact, output genuinely different from the full
+    scan's), an un-warmed one is rejected AT SUBMIT with the warm list
+    (same no-cold-compile-mid-serve contract as per-request steps), and
+    ``quant_mode`` is an assertion about the SET — a mismatch is rejected
+    naming the served mode (weights are quantized at set build, never per
+    request)."""
+    r_base = engine.submit(_rabbit_request())
+    rec_base = engine.result(r_base, wait_s=300.0)
+    assert rec_base["status"] == "done", rec_base.get("error")
+
+    rid = engine.submit(_rabbit_request(reuse_schedule="uniform:2"))
+    rec = engine.result(rid, wait_s=300.0)
+    assert rec["status"] == "done", rec.get("error")
+    assert rec["store_hit"] is True
+    assert rec["src_err"] == 0.0  # stream 0 is REPLAYED, reuse or not
+    assert not np.array_equal(engine.videos(rid), engine.videos(r_base))
+
+    with pytest.raises(ValueError, match=r"not a warmed schedule"):
+        engine.submit(_rabbit_request(reuse_schedule="uniform:3"))
+    # malformed schedules fail validation before the warm-list check
+    with pytest.raises(ValueError, match="uniform:K"):
+        engine.submit(_rabbit_request(reuse_schedule="uniform:x"))
+    with pytest.raises(ValueError, match=r"quant_mode='off'"):
+        engine.submit(_rabbit_request(quant_mode="w8"))
+    # matching the served mode is a no-op assertion, not a rejection
+    rid2 = engine.submit(_rabbit_request(quant_mode="off"))
+    assert engine.result(rid2, wait_s=300.0)["status"] == "done"
+    # healthz/warm summary advertises the admitted schedules and mode
+    assert sorted(engine.warm_reuse) == ["off", "uniform:2"]
+    assert engine.programs.warmed["quant"] == "off"
+
+
 def test_engine_metrics_report_reservoir_latency(engine):
     m = engine.metrics()
     lat = m["request_latency"]
@@ -561,6 +596,14 @@ def test_http_roundtrip_and_metrics(engine):
             client.submit({"prompt": "a", "bogus": True})
         with pytest.raises(RuntimeError, match="400"):
             client.submit({**_rabbit_request().to_dict(), "steps": 37})
+        # un-warmed reuse schedule / mismatched quant mode -> 400 too
+        # (ISSUE 15: the admission contract is HTTP-pinned)
+        with pytest.raises(RuntimeError, match="400"):
+            client.submit({**_rabbit_request().to_dict(),
+                           "reuse_schedule": "uniform:5"})
+        with pytest.raises(RuntimeError, match="400"):
+            client.submit({**_rabbit_request().to_dict(),
+                           "quant_mode": "w8"})
     finally:
         server.close()
     assert not engine_available(server.url)

@@ -16,6 +16,18 @@ row-parallel output projection, declarative all-reduce vs the explicit
 per-frame-count comm+flop evidence lands in ``bench_details.json`` even
 on ``backend_unavailable`` rounds (``bench.record_frame_scaling``).
 
+The PER-CALL cost units (ISSUE 15): ``unet_unit_{fp,w8,w8a8}`` — one UNet
+forward at the cached edit's 2-stream batch with full-precision, int8
+weight-quantized, and weight+activation-quantized parameters (the w8 tree
+comes from ``jax.eval_shape`` over the real ``quantize_unet_params``
+converter, so the 1-byte weights ARE the analyzed program's inputs and the
+argument-bytes delta is the weight-footprint claim) — and
+``reuse_unit_<K>`` — one straight-line DeepCache block (a capture forward
++ K−1 shallow forwards, loop-free so the static flop count is the true
+K-step count; a ``lax.cond``'s static analysis would count BOTH branches).
+``bench.per_call_cost_records`` turns these into the quantization/reuse
+evidence rows.
+
 Builds the bench's headline programs (the captured inversion, the cached
 2-stream edit, and the fused e2e — the same pipeline calls
 ``bench.build_fast_edit_working_point`` jits) against ABSTRACT inputs
@@ -63,9 +75,16 @@ from videop2p_tpu.cli.common import enable_compile_cache  # noqa: E402
 enable_compile_cache()
 
 
-def build_abstract_programs(frames: int, steps: int, tiny: bool):
+def build_abstract_programs(frames: int, steps: int, tiny: bool,
+                            reuse_ks=()):
     """(name → (jitted, abstract_args)) for the bench working point, with
-    every array an eval_shape/ShapeDtypeStruct — no device execution."""
+    every array an eval_shape/ShapeDtypeStruct — no device execution.
+
+    ``reuse_ks``: extra ``reuse_unit_<K>`` straight-line DeepCache programs
+    to build (one capture forward + K−1 shallow forwards, loop-free — the
+    only form whose STATIC cost counts are true per-K-step counts, since
+    ``cost_analysis`` counts a ``lax.cond``'s BOTH branches and a scan body
+    once)."""
     from videop2p_tpu.control import make_controller
     from videop2p_tpu.core import DDIMScheduler
     from videop2p_tpu.models import UNet3DConditionModel, UNet3DConfig
@@ -183,7 +202,34 @@ def build_abstract_programs(frames: int, steps: int, tiny: bool):
         updates, opt_state = adam.update(grads, opt_state, u)
         return optax.apply_updates(u, updates), loss
 
-    return {
+    # per-call cost UNIT programs (ISSUE 15, bench.per_call_cost_records):
+    # ONE UNet forward at the cached edit's batch geometry (2 streams:
+    # edit uncond + edit cond) in each quantization mode. The quantized
+    # trees come from jax.eval_shape over the REAL load-time converter
+    # (models/convert.quantize_unet_params), so the analyzed programs take
+    # the actual 1-byte weight tensors as inputs — argument_bytes IS the
+    # weight-footprint evidence.
+    from videop2p_tpu.models.quant import fake_quant_act
+    from videop2p_tpu.models.convert import quantize_unet_params
+
+    xt_unit = jax.ShapeDtypeStruct((2, frames, lat, lat, 4), jnp.bfloat16)
+    params_w8 = jax.eval_shape(
+        lambda p: quantize_unet_params(p, mode="w8"), params
+    )
+    model_a8 = UNet3DConditionModel(config=cfg, dtype=jnp.bfloat16,
+                                    act_quant_fn=fake_quant_act)
+    fn_a8 = make_unet_fn(model_a8)
+
+    def unet_unit(p, x, t, text):
+        eps, _ = fn(p, x, t, text, None)
+        return eps
+
+    def unet_unit_a8(p, x, t, text):
+        eps, _ = fn_a8(p, x, t, text, None)
+        return eps
+
+    t_unit = jax.ShapeDtypeStruct((), jnp.int32)
+    programs = {
         "invert_captured": (invert_captured, (params, x0, cond_src)),
         "edit_cached": (edit_cached, (params, xt_sds, cond, uncond, cached_sds)),
         "e2e_cached": (e2e_cached, (params, x0, cond_src, cond, uncond)),
@@ -194,7 +240,45 @@ def build_abstract_programs(frames: int, steps: int, tiny: bool):
             jax.jit(unit_inner),
             (params, u_sds, lat_f32, t_sds, lat_f32, lat_f32),
         ),
+        "unet_unit_fp": (jax.jit(unet_unit), (params, xt_unit, t_unit, cond)),
+        "unet_unit_w8": (
+            jax.jit(unet_unit), (params_w8, xt_unit, t_unit, cond)
+        ),
+        "unet_unit_w8a8": (
+            jax.jit(unet_unit_a8), (params_w8, xt_unit, t_unit, cond)
+        ),
     }
+
+    # straight-line DeepCache blocks: one full forward CAPTURING the deep
+    # feature (the final up block's input) + K−1 SHALLOW forwards reusing
+    # it — exactly what reuse_schedule="uniform:K" runs per K-step window
+    # inside the fused edit scan, unrolled here so the static flop count
+    # is the true K-step count
+    # each step gets its OWN abstract latent and timestep (as the real
+    # scan does): with a shared x the shallow forward is an exact
+    # subcomputation of the capture forward and XLA CSE deletes it,
+    # zeroing the count the unit exists to measure
+    def make_reuse_unit(k):
+        def reuse_unit(p, xs, ts, text):
+            (eps, deep), _ = fn(p, xs[0], ts[0], text, None,
+                                deep_mode="capture")
+            acc = eps
+            for i in range(1, k):
+                eps_s, _ = fn(p, xs[i], ts[i], text, None,
+                              deep_mode="shallow", deep_feature=deep)
+                acc = acc + eps_s
+            return acc
+        return jax.jit(reuse_unit)
+
+    for k in sorted(set(int(k) for k in reuse_ks)):
+        if k < 1:
+            raise ValueError(f"reuse_unit K must be >= 1, got {k}")
+        xs_unit = jax.ShapeDtypeStruct((k,) + xt_unit.shape, jnp.bfloat16)
+        ts_unit = jax.ShapeDtypeStruct((k,), jnp.int32)
+        programs[f"reuse_unit_{k}"] = (
+            make_reuse_unit(k), (params, xs_unit, ts_unit, cond)
+        )
+    return programs
 
 
 def unit_program_records(wanted: List[str], shards: int):
@@ -277,12 +361,23 @@ def main(argv: List[str]) -> int:
             ).strip()
 
     pipeline_wanted = [p for p in wanted if p not in unit_wanted]
-    programs = build_abstract_programs(args.frames, args.steps, args.tiny)
+    reuse_ks = []
+    for p in pipeline_wanted:
+        if p.startswith("reuse_unit_"):
+            kpart = p[len("reuse_unit_"):]
+            if not kpart.isdigit() or int(kpart) < 1:
+                print(f"cpu_cost_capture: bad reuse unit name {p!r} "
+                      "(want reuse_unit_<K>, K >= 1)", file=sys.stderr)
+                return 2
+            reuse_ks.append(int(kpart))
+    programs = build_abstract_programs(args.frames, args.steps, args.tiny,
+                                       reuse_ks=reuse_ks)
     unknown = [p for p in pipeline_wanted if p not in programs]
     if unknown:
         print(f"cpu_cost_capture: unknown programs {unknown} "
-              f"(have {sorted(programs)} + ring_unit_<variant>_f<F> + "
-              f"tp_unit_<gspmd|scatter>)", file=sys.stderr)
+              f"(have {sorted(programs)} + reuse_unit_<K> + "
+              f"ring_unit_<variant>_f<F> + tp_unit_<gspmd|scatter>)",
+              file=sys.stderr)
         return 2
     try:
         unit_records = unit_program_records(unit_wanted, args.shards)

@@ -2,6 +2,7 @@
 
 Usage:  python tools/step_frontier.py [--tiny] [--frames 2]
             [--base_steps 50] [--steps 50,20,8]
+            [--variants w8+off,off+uniform:2,w8+uniform:2]
 
 Runs ONE ``--base_steps`` captured DDIM inversion and then the cached
 controlled edit at each requested step count via exact timestep-subset
@@ -19,6 +20,15 @@ claim. One JSON line per step count, flushed as each finishes, so a
 caller's timeout keeps whatever completed. ``--tiny`` swaps in the tiny
 UNet (the test/backend-down configuration; SD scale would take hours of
 CPU execute).
+
+``--variants`` (ISSUE 15) adds per-call-cost rows to the same frontier:
+a comma list of ``<quant_mode>+<reuse_schedule>`` pairs (each split on
+its first ``+``; ``custom:`` schedules are comma-bearing and so not
+expressible here — use ``off``/``uniform:K``), each running the
+full-step cached edit with int8-quantized weights and/or a DeepCache
+reuse schedule and scored against the full-precision full-step edit.
+The replay-exactness invariant applies to these rows too: ``src_err``
+must stay 0.0 under both knobs.
 """
 
 from __future__ import annotations
@@ -57,7 +67,22 @@ def main(argv: List[str]) -> int:
                         help="tiny UNet config (the CPU-executable scale)")
     parser.add_argument("--no_time", action="store_true",
                         help="skip the timing dispatches (quality only)")
+    parser.add_argument("--variants", type=str, default="",
+                        help="comma list of quant_mode+reuse_schedule pairs "
+                             "(e.g. w8+off,off+uniform:2,w8+uniform:2)")
     args = parser.parse_args(argv[1:])
+
+    variants = []
+    for entry in args.variants.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        if "+" not in entry:
+            print(f"step_frontier: --variants entry {entry!r} is not "
+                  "<quant_mode>+<reuse_schedule>", file=sys.stderr)
+            return 2
+        qm, rs = entry.split("+", 1)
+        variants.append((qm, rs))
 
     import bench
 
@@ -88,7 +113,7 @@ def main(argv: List[str]) -> int:
     records, _ = bench.run_step_frontier(
         fn, params, sched, cond, uncond, x0,
         base_steps=args.base_steps, step_counts=step_counts,
-        timed=not args.no_time,
+        timed=not args.no_time, variants=tuple(variants),
     )
     rc = 0
     for rec in records:

@@ -257,6 +257,15 @@ def main(
     # the capture trees over frames; tests/test_parallel.py pins
     # sharded==unsharded), else falls back live
     cached_source: bool = True,
+    # per-UNet-call cost levers (ISSUE 15). quant_mode quantizes the UNet
+    # weights at load (models/convert.quantize_unet_params — int8 storage,
+    # per-output-channel scales, dequantized inside the traced program);
+    # reuse_schedule ("uniform:K" / "custom:<p0,...>") enables cross-step
+    # deep-feature reuse in the cached edit scan (pipelines/reuse.py) and
+    # requires the cached fast path. Both "off" by default — the off paths
+    # are pinned bit-exact.
+    quant_mode: str = "off",
+    reuse_schedule: str = "off",
     # persist/reuse inversion products under the results dir so a repeat edit
     # of the same clip skips DDIM inversion and null-text entirely (the
     # reference's commented-out intent, run_videop2p.py:663-673)
@@ -374,11 +383,27 @@ def main(
     # HBM (gradient_checkpointing=not fast).
     from videop2p_tpu.serve.programs import ProgramSet, ProgramSpec
 
+    from videop2p_tpu.pipelines.reuse import validate_reuse_schedule
+
+    reuse_schedule = validate_reuse_schedule(reuse_schedule, NUM_DDIM_STEPS)
+    if reuse_schedule != "off" and not (cached_source and fast and eta == 0):
+        raise ValueError(
+            "reuse_schedule is a cached-fast-path knob: it needs --fast with "
+            "eta=0 and cached_source (the deep-feature cache rides the fused "
+            "edit scan)"
+        )
+    if quant_mode != "off" and not fast:
+        raise ValueError(
+            "quant_mode is an INFERENCE knob: full mode differentiates "
+            "through the UNet (null-text optimization) and must see the "
+            "full-precision weights — run it with --fast"
+        )
     program_set = ProgramSet(ProgramSpec(
         checkpoint=pretrained_model_path, width=width, video_len=video_len,
         steps=NUM_DDIM_STEPS, guidance_scale=GUIDANCE_SCALE, tiny=tiny,
         mixed_precision=mixed_precision, seed=seed, mesh=mesh,
         gradient_checkpointing=not fast,
+        quant_mode=quant_mode, reuse_schedule=reuse_schedule,
     ))
     bundle, dtype = program_set.bundle, program_set.dtype
     device_mesh = program_set.mesh
@@ -525,16 +550,20 @@ def main(
         if not fits:
             print(
                 f"[p2p] cached-source maps need {per_chip_gb:.1f} GiB/chip "
-                f"even with float8 temporal maps (> budget {budget_gb:.1f} "
+                f"even with 1-byte temporal maps (> budget {budget_gb:.1f} "
                 "GiB) — falling back to the live source stream"
             )
             use_cached = False
+            if reuse_schedule != "off":
+                print("[p2p] reuse_schedule disabled with it — the deep-"
+                      "feature cache rides the cached edit scan")
+                reuse_schedule = "off"
         else:
             print(
                 f"[p2p] cached-source fast mode: cross window {cross_len} steps, "
                 f"self window {self_window}, maps {map_gb:.2f} GiB global / "
                 f"{per_chip_gb:.2f} GiB per chip"
-                + (", temporal maps stored float8"
+                + (f", temporal maps stored {jnp.dtype(tm_dtype).name}"
                    if tm_dtype is not None else "")
             )
 
@@ -590,6 +619,7 @@ def main(
                     telemetry=telemetry,
                     device_probe=device_probe,
                     attn_maps=attn_maps,
+                    reuse_schedule=reuse_schedule,
                 )
                 traj, edited = res[0], res[1]
                 vids = decode_video(bundle.vae, vp, edited.astype(dtype), sequential=True)
@@ -881,6 +911,20 @@ if __name__ == "__main__":
                         help="model compute dtype (default fp32 = the "
                              "reference's Stage-2 behavior; bf16 runs the "
                              "MXU at full rate — ~3.5x faster end-to-end)")
+    parser.add_argument("--quant_mode", type=str, default="off",
+                        choices=["off", "w8", "w8a8"],
+                        help="UNet weight quantization at load (--fast "
+                             "only): w8 = int8 weights + per-output-channel "
+                             "scales stored 1-byte and dequantized inside "
+                             "the traced program; w8a8 adds activation "
+                             "fake-quant at the attention Dense boundaries")
+    parser.add_argument("--reuse_schedule", type=str, default="off",
+                        help="cross-step deep-feature reuse in the cached "
+                             "fast edit ('uniform:K' or "
+                             "'custom:<p0,p1,...>'): listed steps run the "
+                             "full UNet, the rest reuse the cached deep "
+                             "feature through a shallow path — one compiled "
+                             "program either way")
     add_dependent_args(parser)
     add_null_text_args(parser)
     add_obs_args(parser)
@@ -918,6 +962,8 @@ if __name__ == "__main__":
         mesh=args.mesh,
         multi=args.multi,
         cached_source=not args.live_source,
+        quant_mode=args.quant_mode,
+        reuse_schedule=args.reuse_schedule,
         reuse_inversion=not args.no_reuse_inversion,
         inv_store=args.inv_store,
         telemetry=args.telemetry,

@@ -104,6 +104,27 @@ def build_parser() -> argparse.ArgumentParser:
                          "20 8): exact timestep subsets of --steps served "
                          "from the SAME inversion products; per-request "
                          "'steps' outside the warmed buckets is a 400")
+    # per-UNet-call cost levers (ISSUE 15 — models/quant.py,
+    # pipelines/reuse.py; docs/PERF_ANALYSIS.md "Per-call cost")
+    ap.add_argument("--quant_mode", type=str, default="off",
+                    choices=["off", "w8", "w8a8"],
+                    help="UNet weight quantization at set build: w8 = int8 "
+                         "weights with per-output-channel scales (1-byte "
+                         "program inputs, dequantized at the matmul seam); "
+                         "w8a8 adds dynamic activation fake-quant at the "
+                         "attention Dense boundaries. Fixed per set — "
+                         "requests asserting another mode get a 400; "
+                         "enters the spec fingerprint")
+    ap.add_argument("--reuse_schedule", type=str, default="off",
+                    help="default cross-step deep-feature reuse schedule "
+                         "('uniform:K' or 'custom:<p0,p1,...>'): designated "
+                         "steps run the full UNet, the rest reuse the "
+                         "cached deep feature through a shallow path — "
+                         "still ONE compiled program; enters the spec "
+                         "fingerprint")
+    ap.add_argument("--reuse_buckets", type=str, nargs="*", default=[],
+                    help="additional reuse schedules to warm; per-request "
+                         "'reuse_schedule' outside the warmed set is a 400")
     # resilience knobs (ISSUE 9 — docs/SERVING.md "Failure semantics")
     ap.add_argument("--max_queue", type=int, default=64,
                     help="bounded admit queue: over this many in-flight "
@@ -167,6 +188,7 @@ def main(argv=None) -> int:
         guidance_scale=args.guidance_scale, tiny=args.tiny,
         mixed_precision=args.mixed_precision, seed=args.seed, mesh=args.mesh,
         ring_variant=args.ring_variant, tp_collectives=args.tp_collectives,
+        quant_mode=args.quant_mode, reuse_schedule=args.reuse_schedule,
     )
     faults = FaultPlan.parse(args.faults) if args.faults else None
     if faults is not None:
@@ -199,10 +221,12 @@ def main(argv=None) -> int:
         print(f"[serve] warming programs (spec {engine.spec.fingerprint()})...")
         info = engine.warm(tuple(args.warm_prompts),
                            batch_sizes=(min(2, args.max_batch),),
-                           step_buckets=tuple(args.step_buckets))
+                           step_buckets=tuple(args.step_buckets),
+                           reuse_schedules=tuple(args.reuse_buckets))
         print(f"[serve] warm in {info['seconds']}s "
               f"(batch sizes {info['batch_sizes']}, "
-              f"step buckets {info['steps']})")
+              f"step buckets {info['steps']}, "
+              f"reuse {info['reuse']}, quant {info['quant']})")
     server = make_server(engine, host=args.host, port=args.port)
     print(f"[serve] listening on {server.url}  "
           f"(ledger: {engine.ledger.path})")

@@ -104,6 +104,13 @@ def _merge_heads(x: jax.Array) -> jax.Array:
     return x.transpose(0, 2, 1, 3).reshape(b, n, h * d)
 
 
+def _aq(fn: Optional[Callable], x: jax.Array) -> jax.Array:
+    """Apply an activation fake-quant seam (``w8a8`` quant mode —
+    models/quant.py ``fake_quant_act``) to a Dense input; identity when no
+    seam is wired, so the off path's program is byte-identical."""
+    return x if fn is None else fn(x)
+
+
 def _stable_softmax(sim: jax.Array, dtype: Dtype) -> jax.Array:
     """Softmax in float32 regardless of compute dtype (the reference's
     exp(sim−max)/Σ stabilization, ptp_utils.py:217).
@@ -139,11 +146,15 @@ class FrameAttention(nn.Module):
     # psum_scatter over the token axis instead of the all-reduce GSPMD
     # inserts when the kernel's rows shard over ``tensor``)
     row_parallel_dot: Optional[Callable] = None
+    # activation fake-quant at the Dense boundaries (w8a8 quant mode);
+    # None → byte-identical off path (same seam pattern as row_parallel_dot)
+    act_quant_fn: Optional[Callable] = None
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         b, f, n, _ = x.shape
         inner = self.heads * self.dim_head
+        x = _aq(self.act_quant_fn, x)
         q = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_q")(x)
         kv_src = x[:, 0]  # frame-0 KV (attention.py:296-302)
         k = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_k")(kv_src)
@@ -164,6 +175,7 @@ class FrameAttention(nn.Module):
         out = out.transpose(0, 1, 3, 2, 4).reshape(b, f, n, inner)
         rp = ({"dot_general": self.row_parallel_dot}
               if self.row_parallel_dot is not None else {})
+        out = _aq(self.act_quant_fn, out)
         return nn.Dense(inner, dtype=self.dtype, name="to_out", **rp)(out)
 
 
@@ -193,6 +205,8 @@ class ControlledAttention(nn.Module):
     # threads it to the CROSS site only — the temporal site's token axis is
     # the frame axis, which belongs to the ``frames`` mesh axis
     row_parallel_dot: Optional[Callable] = None
+    # activation fake-quant at the Dense boundaries (see FrameAttention)
+    act_quant_fn: Optional[Callable] = None
 
     @nn.compact
     def __call__(
@@ -203,7 +217,8 @@ class ControlledAttention(nn.Module):
         video_length: Optional[int] = None,
     ) -> jax.Array:
         inner = self.heads * self.dim_head
-        ctx_in = x if context is None else context
+        x = _aq(self.act_quant_fn, x)
+        ctx_in = x if context is None else _aq(self.act_quant_fn, context)
 
         q = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_q")(x)
         k = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_k")(ctx_in)
@@ -212,7 +227,7 @@ class ControlledAttention(nn.Module):
 
         if self.attention_fn is not None and control is None:
             out = self.attention_fn(q, k, v)
-            out = _merge_heads(out)
+            out = _aq(self.act_quant_fn, _merge_heads(out))
             return nn.Dense(inner, dtype=self.dtype, name="to_out",
                             **self._out_kwargs())(out)
 
@@ -261,7 +276,7 @@ class ControlledAttention(nn.Module):
                 )
 
         out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
-        out = _merge_heads(out)
+        out = _aq(self.act_quant_fn, _merge_heads(out))
         return nn.Dense(inner, dtype=self.dtype, name="to_out",
                         **self._out_kwargs())(out)
 
@@ -283,15 +298,20 @@ class FeedForward(nn.Module):
     dtype: Dtype = jnp.float32
     # explicit Megatron row-parallel proj_out (see FrameAttention)
     row_parallel_dot: Optional[Callable] = None
+    # activation fake-quant at the Dense boundaries (see FrameAttention)
+    act_quant_fn: Optional[Callable] = None
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         inner = self.dim * self.mult
-        h = nn.Dense(inner * 2, dtype=self.dtype, name="proj_geglu")(x)
+        h = nn.Dense(inner * 2, dtype=self.dtype, name="proj_geglu")(
+            _aq(self.act_quant_fn, x)
+        )
         h, gate = jnp.split(h, 2, axis=-1)
         h = h * nn.gelu(gate)
         rp = ({"dot_general": self.row_parallel_dot}
               if self.row_parallel_dot is not None else {})
+        h = _aq(self.act_quant_fn, h)
         return nn.Dense(self.dim, dtype=self.dtype, name="proj_out", **rp)(h)
 
 
@@ -313,6 +333,8 @@ class BasicTransformerBlock(nn.Module):
     # psum_scatter; the temporal site's tokens are frames — that axis
     # belongs to the ``frames`` mesh axis and stays declarative
     row_parallel_dot: Optional[Callable] = None
+    # activation fake-quant at every Dense boundary (w8a8 quant mode)
+    act_quant_fn: Optional[Callable] = None
 
     @nn.compact
     def __call__(
@@ -327,7 +349,8 @@ class BasicTransformerBlock(nn.Module):
         x = x + FrameAttention(
             heads=self.heads, dim_head=self.dim_head, dtype=self.dtype,
             attention_fn=self.frame_attention_fn,
-            row_parallel_dot=self.row_parallel_dot, name="attn1",
+            row_parallel_dot=self.row_parallel_dot,
+            act_quant_fn=self.act_quant_fn, name="attn1",
         )(h)
 
         if context is not None:
@@ -342,12 +365,13 @@ class BasicTransformerBlock(nn.Module):
             attn2 = ControlledAttention(
                 heads=self.heads, dim_head=self.dim_head, site="cross",
                 dtype=self.dtype, row_parallel_dot=self.row_parallel_dot,
-                name="attn2",
+                act_quant_fn=self.act_quant_fn, name="attn2",
             )(h, context=ctx_flat, control=control, video_length=f)
             x = x + attn2.reshape(b, f, n, c)
 
         x = x + FeedForward(self.dim, dtype=self.dtype,
-                            row_parallel_dot=self.row_parallel_dot, name="ff")(
+                            row_parallel_dot=self.row_parallel_dot,
+                            act_quant_fn=self.act_quant_fn, name="ff")(
             nn.LayerNorm(dtype=self.dtype, name="norm3")(x)
         )
 
@@ -358,7 +382,8 @@ class BasicTransformerBlock(nn.Module):
         attn_temp = ControlledAttention(
             heads=self.heads, dim_head=self.dim_head, site="temporal",
             zero_init_out=True, dtype=self.dtype,
-            attention_fn=self.temporal_attention_fn, name="attn_temp",
+            attention_fn=self.temporal_attention_fn,
+            act_quant_fn=self.act_quant_fn, name="attn_temp",
         )(h, control=control, video_length=f)
         x = x + attn_temp.reshape(b, n, f, c).transpose(0, 2, 1, 3)
         return x
@@ -379,6 +404,7 @@ class Transformer3DModel(nn.Module):
     frame_attention_fn: Optional[Callable] = None
     temporal_attention_fn: Optional[Callable] = None
     row_parallel_dot: Optional[Callable] = None
+    act_quant_fn: Optional[Callable] = None
 
     @nn.compact
     def __call__(
@@ -402,7 +428,9 @@ class Transformer3DModel(nn.Module):
         h = h.reshape(b, f, hh, ww, c)
         # use_linear_projection=False in SD1.x is a 1×1 conv — identical to a
         # Dense in channels-last layout (attention.py:74-81)
-        h = nn.Dense(inner, dtype=self.dtype, name="proj_in")(h)
+        h = nn.Dense(inner, dtype=self.dtype, name="proj_in")(
+            _aq(self.act_quant_fn, h)
+        )
         h = h.reshape(b, f, hh * ww, inner)
 
         for i in range(self.depth):
@@ -411,11 +439,14 @@ class Transformer3DModel(nn.Module):
                 dtype=self.dtype, frame_attention_fn=self.frame_attention_fn,
                 temporal_attention_fn=self.temporal_attention_fn,
                 row_parallel_dot=self.row_parallel_dot,
+                act_quant_fn=self.act_quant_fn,
                 name=f"blocks_{i}",
             )(h, context=context, control=control)
 
         h = h.reshape(b, f, hh, ww, inner)
         rp = ({"dot_general": self.row_parallel_dot}
               if self.row_parallel_dot is not None else {})
-        h = nn.Dense(c, dtype=self.dtype, name="proj_out", **rp)(h)
+        h = nn.Dense(c, dtype=self.dtype, name="proj_out", **rp)(
+            _aq(self.act_quant_fn, h)
+        )
         return h + residual

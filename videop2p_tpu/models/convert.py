@@ -37,6 +37,7 @@ __all__ = [
     "load_state_dict",
     "unet3d_params_from_torch",
     "unet3d_params_to_torch",
+    "quantize_unet_params",
     "vae_params_from_torch",
     "clip_params_from_torch",
 ]
@@ -206,6 +207,33 @@ def unet3d_params_to_torch(params) -> StateDict:
         )
         out[torch_key] = _from_flax_tensor(np.asarray(leaf), kind, conv1x1=conv1x1)
     return out
+
+
+def quantize_unet_params(params, mode: str = "w8", weight_dtype: str = "int8"):
+    """Post-training quantization of a flax video-UNet param tree at load
+    time (ISSUE 15): every matmul kernel outside the first/last-layer
+    precision islands becomes a :class:`~videop2p_tpu.models.quant
+    .QuantizedTensor` (int8 or fp8-e4m3 storage + per-output-channel fp32
+    scales). The low-precision tree feeds the SAME ``make_unet_fn``
+    programs — the adapter dequantizes inside the trace, so the 1-byte
+    weights stay the program inputs. ``mode="off"`` returns ``params``
+    unchanged (the pinned bit-exact path); ``w8`` and ``w8a8`` quantize
+    identically here (the a8 half is the model's ``act_quant_fn`` seam,
+    wired by the caller). Works on either the bare ``{"params": ...}``
+    collection dict or its inner tree.
+    """
+    from videop2p_tpu.models.quant import quantize_tree, quant_weight_dtype, \
+        validate_quant_mode
+
+    mode = validate_quant_mode(mode)
+    if mode == "off":
+        return params
+    dtype = quant_weight_dtype(weight_dtype)
+    if isinstance(params, dict) and "params" in params:
+        out = dict(params)
+        out["params"] = quantize_tree(params["params"], dtype=dtype)
+        return out
+    return quantize_tree(params, dtype=dtype)
 
 
 # --------------------------------------------------------------------- #

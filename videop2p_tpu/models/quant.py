@@ -1,0 +1,198 @@
+"""Post-training UNet weight quantization (ISSUE 15, Q-Diffusion-style).
+
+int8 (or float8-e4m3 where the dtype exists) weight storage with
+PER-OUTPUT-CHANNEL symmetric scales, computed once at load time
+(:func:`videop2p_tpu.models.convert.quantize_unet_params`). The storage
+convention follows the float8 temporal-map capture
+(``pipelines/fast.py choose_cached_maps``): store low-precision, upcast
+to the sibling compute dtype exactly at the matmul seam —
+:func:`videop2p_tpu.pipelines.sampling.make_unet_fn` dequantizes INSIDE
+the traced program, so XLA holds the 1-byte weights as program inputs
+(≈4× parameter bytes-accessed cut vs fp32; the dequant itself is a fused
+elementwise multiply) and every matmul still runs in the model dtype.
+
+Modes (``QUANT_MODES``):
+  * ``"off"``  — no quantization; the program is byte-identical (pinned).
+  * ``"w8"``   — int8 weights, per-output-channel scales.
+  * ``"w8a8"`` — w8 plus dynamic per-tensor activation fake-quant at the
+    Dense boundaries of models/attention.py (``fake_quant_act`` wired via
+    the model's ``act_quant_fn`` seam, same threading as
+    ``row_parallel_dot``).
+
+First/last-layer precision practice (Q-Diffusion §4): ``conv_in``,
+``conv_out`` and the time embedding stay full precision — ``SKIP_MODULES``.
+
+Stdlib + jax only — safe for the import-guarded packages to reach.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "QUANT_MODES",
+    "SKIP_MODULES",
+    "QuantizedTensor",
+    "validate_quant_mode",
+    "quantize_weight",
+    "fake_quant_act",
+    "quantize_tree",
+    "has_quantized",
+    "dequantize_tree",
+    "quant_weight_dtype",
+]
+
+QUANT_MODES = ("off", "w8", "w8a8")
+
+# full-precision islands: the in/out convs and the time MLP carry the
+# widest dynamic range for the fewest parameters (Q-Diffusion keeps the
+# first and last layers unquantized for the same reason)
+SKIP_MODULES = ("conv_in", "conv_out", "time_embedding")
+
+
+def validate_quant_mode(mode: Optional[str]) -> str:
+    """Normalize/validate a ``quant_mode`` knob value (None → "off")."""
+    mode = "off" if mode is None else str(mode)
+    if mode not in QUANT_MODES:
+        raise ValueError(
+            f"quant_mode={mode!r} is not one of {QUANT_MODES} — "
+            "off: full precision (bit-exact); w8: int8 weights with "
+            "per-output-channel scales; w8a8: w8 plus dynamic per-tensor "
+            "activation fake-quant at the attention Dense boundaries"
+        )
+    return mode
+
+
+def quant_weight_dtype(name: str = "int8"):
+    """Resolve a storage dtype name, preferring int8; ``"fp8"`` selects
+    float8-e4m3 where this jax exposes it (falls back to int8 otherwise —
+    same graceful degradation as ``choose_cached_maps``)."""
+    if name in ("fp8", "float8_e4m3fn"):
+        dt = getattr(jnp, "float8_e4m3fn", None)
+        if dt is not None:
+            return dt
+    return jnp.int8
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantizedTensor:
+    """A low-precision weight: ``qvalue`` (int8 or fp8-e4m3, the original
+    kernel's shape) plus a broadcastable fp32 per-output-channel ``scale``
+    (flax kernels put the output channel LAST — Dense ``(in, out)``,
+    InflatedConv ``(kh, kw, in, out)`` — so the scale reduces every axis
+    but the last). ``dequantize`` is the one seam back to compute dtype."""
+
+    def __init__(self, qvalue: jax.Array, scale: jax.Array):
+        self.qvalue = qvalue
+        self.scale = scale
+
+    # array-protocol conveniences so shape/byte accounting (tree_bytes,
+    # eval_shape prints) keep working over quantized trees
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return self.qvalue.shape
+
+    @property
+    def ndim(self) -> int:
+        return self.qvalue.ndim
+
+    @property
+    def dtype(self):
+        return self.qvalue.dtype
+
+    def dequantize(self, dtype=jnp.float32) -> jax.Array:
+        return (self.qvalue.astype(jnp.float32) * self.scale).astype(dtype)
+
+    def tree_flatten(self):
+        return (self.qvalue, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        del aux
+        return cls(*children)
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return (f"QuantizedTensor(shape={tuple(self.qvalue.shape)}, "
+                f"dtype={jnp.dtype(self.qvalue.dtype).name})")
+
+
+def quantize_weight(w: jax.Array, *, dtype=jnp.int8) -> QuantizedTensor:
+    """One kernel → :class:`QuantizedTensor` with symmetric
+    per-output-channel scales (absmax over every axis but the last)."""
+    wf = jnp.asarray(w).astype(jnp.float32)
+    axes = tuple(range(wf.ndim - 1))
+    amax = jnp.max(jnp.abs(wf), axis=axes, keepdims=True)
+    if jnp.issubdtype(jnp.dtype(dtype), jnp.integer):
+        qmax = float(jnp.iinfo(dtype).max)  # 127 — symmetric, no -128
+        scale = jnp.maximum(amax, 1e-12) / qmax
+        q = jnp.clip(jnp.round(wf / scale), -qmax, qmax).astype(dtype)
+    else:
+        qmax = float(jnp.finfo(dtype).max)  # 448 for e4m3
+        scale = jnp.maximum(amax, 1e-12) / qmax
+        q = (wf / scale).astype(dtype)
+    return QuantizedTensor(q, scale)
+
+
+def fake_quant_act(x: jax.Array) -> jax.Array:
+    """Dynamic per-tensor symmetric int8 round-trip for activations
+    (the ``w8a8`` mode's ``act_quant_fn``): quantize-dequantize in fp32,
+    return in the input dtype — same program structure, a8 noise model."""
+    if not jnp.issubdtype(x.dtype, jnp.floating):
+        return x
+    xf = x.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(xf)), 1e-8) / 127.0
+    q = jnp.clip(jnp.round(xf / scale), -127.0, 127.0)
+    return (q * scale).astype(x.dtype)
+
+
+def _path_names(path) -> Tuple[str, ...]:
+    names = []
+    for k in path:
+        name = getattr(k, "key", None)
+        if name is None:
+            name = getattr(k, "name", None)
+        if name is None:
+            name = str(k)
+        names.append(str(name))
+    return tuple(names)
+
+
+def quantize_tree(params: Any, *, dtype=jnp.int8,
+                  skip: Tuple[str, ...] = SKIP_MODULES) -> Any:
+    """Quantize every matmul kernel in a flax param tree: leaves whose
+    path ends in ``"kernel"`` with ndim ≥ 2, outside the ``skip`` modules.
+    Biases, norms and embeddings stay full precision (they are a rounding
+    error of the byte budget and carry the quality-sensitive offsets)."""
+
+    def maybe(path, leaf):
+        names = _path_names(path)
+        if (names and names[-1] == "kernel"
+                and getattr(leaf, "ndim", 0) >= 2
+                and not any(s in names for s in skip)):
+            return quantize_weight(leaf, dtype=dtype)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(maybe, params)
+
+
+def has_quantized(params: Any) -> bool:
+    """True when any leaf of ``params`` sits under a
+    :class:`QuantizedTensor` node."""
+    leaves = jax.tree_util.tree_leaves(
+        params, is_leaf=lambda x: isinstance(x, QuantizedTensor)
+    )
+    return any(isinstance(x, QuantizedTensor) for x in leaves)
+
+
+def dequantize_tree(params: Any, dtype=jnp.float32) -> Any:
+    """Upcast every :class:`QuantizedTensor` back to ``dtype`` (the
+    sibling-compute-dtype seam ``make_unet_fn`` runs inside the traced
+    program); non-quantized leaves pass through untouched."""
+    return jax.tree_util.tree_map(
+        lambda x: x.dequantize(dtype) if isinstance(x, QuantizedTensor) else x,
+        params,
+        is_leaf=lambda x: isinstance(x, QuantizedTensor),
+    )

@@ -178,6 +178,10 @@ class UNet3DConditionModel(nn.Module):
     # matmuls' all-reduce with a psum_scatter over the token axis on
     # tensor-parallel meshes; None → declarative GSPMD (the default)
     row_parallel_dot: Optional[Callable] = None
+    # activation fake-quant at the transformer Dense boundaries (w8a8 quant
+    # mode — models/quant.py fake_quant_act, wired by ProgramSet/CLIs via
+    # clone, same pattern as the seams above); None → byte-identical off path
+    act_quant_fn: Optional[Callable] = None
 
     @nn.compact
     def __call__(
@@ -186,9 +190,40 @@ class UNet3DConditionModel(nn.Module):
         timesteps: jax.Array,
         encoder_hidden_states: jax.Array,
         control: Optional[AttnControl] = None,
+        deep_mode: str = "full",
+        deep_feature: Optional[jax.Array] = None,
     ) -> jax.Array:
+        """``deep_mode`` (static) is the DeepCache cross-step reuse seam
+        (pipelines/reuse.py):
+
+          * ``"full"``    — the whole UNet; returns ``eps`` (unchanged
+            contract, byte-identical program — pinned).
+          * ``"capture"`` — the whole UNet, additionally returning the deep
+            feature: the input to the FINAL up block (the output of up
+            block n−2, full spatial resolution). Returns ``(eps, deep)``.
+          * ``"shallow"`` — skip every deep stage: conv_in → down block 0
+            (no downsample) → the final up block seeded with
+            ``deep_feature`` (a previous step's capture) → out convs.
+            Adjacent diffusion steps' deep features are nearly identical
+            (Ma et al., 2023), so this trades the deep stack's cost for
+            one cached activation carried in the sampling scan's state.
+
+        ``capture``/``shallow`` need ≥ 2 resolution levels — the split
+        point is the boundary between the last two up blocks.
+        """
         cfg = self.config
         n_blocks = len(cfg.block_out_channels)
+        if deep_mode not in ("full", "capture", "shallow"):
+            raise ValueError(
+                f"deep_mode={deep_mode!r} is not 'full', 'capture' or 'shallow'"
+            )
+        if deep_mode != "full" and n_blocks < 2:
+            raise ValueError(
+                "deep-feature reuse needs >= 2 resolution levels — "
+                f"this config has {n_blocks}"
+            )
+        if deep_mode == "shallow" and deep_feature is None:
+            raise ValueError("deep_mode='shallow' requires deep_feature")
         depths = _per_block(cfg.transformer_depth, n_blocks)
         heads = _per_block(cfg.attention_head_dim, n_blocks)
         frame_attention_fn = (
@@ -214,7 +249,9 @@ class UNet3DConditionModel(nn.Module):
         # --- down path (unet.py:359-374) ---
         x = InflatedConv(cfg.block_out_channels[0], dtype=self.dtype, name="conv_in")(sample)
         res_stack = [x]
-        for i, block_type in enumerate(cfg.down_block_types):
+        down_types = (cfg.down_block_types[:1] if deep_mode == "shallow"
+                      else cfg.down_block_types)
+        for i, block_type in enumerate(down_types):
             is_final = i == n_blocks - 1
             block = unet_blocks.get_down_block(
                 block_type,
@@ -224,7 +261,11 @@ class UNet3DConditionModel(nn.Module):
                 num_layers=cfg.layers_per_block,
                 transformer_depth=depths[i],
                 attn_heads=heads[i],
-                add_downsample=not is_final,
+                # the shallow path never descends: the downsample conv's
+                # output only feeds the deep stages being skipped, so the
+                # block is built without it (params bind by name — the
+                # unvisited downsample kernel is simply not looked up)
+                add_downsample=not is_final and deep_mode != "shallow",
                 norm_groups=cfg.norm_num_groups,
                 gn_impl=cfg.group_norm,
                 group_norm_fn=self.group_norm_fn,
@@ -232,6 +273,7 @@ class UNet3DConditionModel(nn.Module):
                 frame_attention_fn=frame_attention_fn,
                 temporal_attention_fn=self.temporal_attention_fn,
                 row_parallel_dot=self.row_parallel_dot,
+                act_quant_fn=self.act_quant_fn,
                 name=f"down_blocks_{i}",
             )
             if block_type == "CrossAttnDownBlock3D":
@@ -240,38 +282,54 @@ class UNet3DConditionModel(nn.Module):
                 x, res = block(x, temb)
             res_stack.extend(res)
 
-        # --- mid (unet.py:377) ---
-        mid_cls = (
-            nn.remat(
-                unet_blocks.UNetMidBlock3DCrossAttn,
-                policy=unet_blocks.resolve_remat_policy(cfg.remat_policy),
+        if deep_mode != "shallow":
+            # --- mid (unet.py:377) ---
+            mid_cls = (
+                nn.remat(
+                    unet_blocks.UNetMidBlock3DCrossAttn,
+                    policy=unet_blocks.resolve_remat_policy(cfg.remat_policy),
+                )
+                if cfg.gradient_checkpointing
+                else unet_blocks.UNetMidBlock3DCrossAttn
             )
-            if cfg.gradient_checkpointing
-            else unet_blocks.UNetMidBlock3DCrossAttn
-        )
-        x = mid_cls(
-            channels=cfg.block_out_channels[-1],
-            transformer_depth=depths[-1],
-            attn_heads=heads[-1],
-            norm_groups=cfg.norm_num_groups,
-            gn_impl=cfg.group_norm,
-            group_norm_fn=self.group_norm_fn,
-            dtype=self.dtype,
-            frame_attention_fn=frame_attention_fn,
-            temporal_attention_fn=self.temporal_attention_fn,
-            row_parallel_dot=self.row_parallel_dot,
-            name="mid_block",
-        )(x, temb, encoder_hidden_states, control)
+            x = mid_cls(
+                channels=cfg.block_out_channels[-1],
+                transformer_depth=depths[-1],
+                attn_heads=heads[-1],
+                norm_groups=cfg.norm_num_groups,
+                gn_impl=cfg.group_norm,
+                group_norm_fn=self.group_norm_fn,
+                dtype=self.dtype,
+                frame_attention_fn=frame_attention_fn,
+                temporal_attention_fn=self.temporal_attention_fn,
+                row_parallel_dot=self.row_parallel_dot,
+                act_quant_fn=self.act_quant_fn,
+                name="mid_block",
+            )(x, temb, encoder_hidden_states, control)
 
         # --- up path (unet.py:382-405) ---
         rev_channels = tuple(reversed(cfg.block_out_channels))
         rev_heads = tuple(reversed(heads))
         rev_depths = tuple(reversed(depths))
-        for i, block_type in enumerate(cfg.up_block_types):
+        deep = None
+        up_indices = ([n_blocks - 1] if deep_mode == "shallow"
+                      else range(len(cfg.up_block_types)))
+        if deep_mode == "shallow":
+            # seed the final up block with the cached deep feature; the
+            # skip connections it concatenates ([conv_in, down block 0's
+            # resnet outputs]) were just recomputed above
+            x = deep_feature.astype(self.dtype)
+        for i in up_indices:
+            block_type = cfg.up_block_types[i]
             is_final = i == n_blocks - 1
             num_layers = cfg.layers_per_block + 1
             res = tuple(res_stack[-num_layers:])
             del res_stack[-num_layers:]
+            if is_final and deep_mode == "capture":
+                # the DeepCache split point: everything above this input
+                # (deep down blocks, mid, up blocks 0..n−2) is what a
+                # shallow step skips
+                deep = x
             block = unet_blocks.get_up_block(
                 block_type,
                 remat=cfg.gradient_checkpointing,
@@ -288,6 +346,7 @@ class UNet3DConditionModel(nn.Module):
                 frame_attention_fn=frame_attention_fn,
                 temporal_attention_fn=self.temporal_attention_fn,
                 row_parallel_dot=self.row_parallel_dot,
+                act_quant_fn=self.act_quant_fn,
                 name=f"up_blocks_{i}",
             )
             if block_type == "CrossAttnUpBlock3D":
@@ -302,4 +361,6 @@ class UNet3DConditionModel(nn.Module):
             group_norm_fn=self.group_norm_fn, name="conv_norm_out",
         )(x)
         x = InflatedConv(cfg.out_channels, dtype=self.dtype, name="conv_out")(x)
+        if deep_mode == "capture":
+            return x, deep
         return x

@@ -52,6 +52,9 @@ class CrossAttnDownBlock3D(nn.Module):
     frame_attention_fn: Optional[Callable] = None
     temporal_attention_fn: Optional[Callable] = None
     row_parallel_dot: Optional[Callable] = None
+    # activation fake-quant at the transformer Dense boundaries (w8a8
+    # quant mode — models/quant.py); None → byte-identical off path
+    act_quant_fn: Optional[Callable] = None
 
     @nn.compact
     def __call__(
@@ -79,6 +82,7 @@ class CrossAttnDownBlock3D(nn.Module):
                 frame_attention_fn=self.frame_attention_fn,
                 temporal_attention_fn=self.temporal_attention_fn,
                 row_parallel_dot=self.row_parallel_dot,
+                act_quant_fn=self.act_quant_fn,
                 name=f"attentions_{i}",
             )(x, context=context, control=control)
             outputs.append(x)
@@ -131,6 +135,9 @@ class UNetMidBlock3DCrossAttn(nn.Module):
     frame_attention_fn: Optional[Callable] = None
     temporal_attention_fn: Optional[Callable] = None
     row_parallel_dot: Optional[Callable] = None
+    # activation fake-quant at the transformer Dense boundaries (w8a8
+    # quant mode — models/quant.py); None → byte-identical off path
+    act_quant_fn: Optional[Callable] = None
 
     @nn.compact
     def __call__(
@@ -157,6 +164,7 @@ class UNetMidBlock3DCrossAttn(nn.Module):
                 frame_attention_fn=self.frame_attention_fn,
                 temporal_attention_fn=self.temporal_attention_fn,
                 row_parallel_dot=self.row_parallel_dot,
+                act_quant_fn=self.act_quant_fn,
                 name=f"attentions_{i}",
             )(x, context=context, control=control)
             x = ResnetBlock3D(
@@ -183,6 +191,9 @@ class CrossAttnUpBlock3D(nn.Module):
     frame_attention_fn: Optional[Callable] = None
     temporal_attention_fn: Optional[Callable] = None
     row_parallel_dot: Optional[Callable] = None
+    # activation fake-quant at the transformer Dense boundaries (w8a8
+    # quant mode — models/quant.py); None → byte-identical off path
+    act_quant_fn: Optional[Callable] = None
 
     @nn.compact
     def __call__(
@@ -211,6 +222,7 @@ class CrossAttnUpBlock3D(nn.Module):
                 frame_attention_fn=self.frame_attention_fn,
                 temporal_attention_fn=self.temporal_attention_fn,
                 row_parallel_dot=self.row_parallel_dot,
+                act_quant_fn=self.act_quant_fn,
                 name=f"attentions_{i}",
             )(x, context=context, control=control)
         if self.add_upsample:
@@ -250,7 +262,7 @@ class UpBlock3D(nn.Module):
 
 _ATTN_ONLY_KWARGS = (
     "transformer_depth", "attn_heads", "frame_attention_fn", "temporal_attention_fn",
-    "row_parallel_dot",
+    "row_parallel_dot", "act_quant_fn",
 )
 
 

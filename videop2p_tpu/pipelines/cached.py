@@ -232,11 +232,18 @@ class CachedSource(struct.PyTreeNode):
             # run a bf16 upcast would silently narrow the replaced base
             # maps while the cross maps stay fp32
             target = self._capture_compute_dtype()
-            temporal = jax.tree.map(
-                lambda a: a.astype(target)
-                if jnp.dtype(a.dtype).itemsize == 1 else a,
-                temporal,
-            )
+
+            def _widen(a):
+                dt = jnp.dtype(a.dtype)
+                if dt.itemsize != 1:
+                    return a
+                if jnp.issubdtype(dt, jnp.integer):
+                    # int8 fixed-point storage (inversion.py encodes
+                    # round(p·127)) — decode, not just upcast
+                    return a.astype(target) / jnp.asarray(127.0, target)
+                return a.astype(target)
+
+            temporal = jax.tree.map(_widen, temporal)
         if cross is None and temporal is None:
             return None
         return merge_site_trees(cross, temporal)

@@ -31,13 +31,23 @@ __all__ = [
 def choose_cached_maps(shapes_for, *, sp: int = 1, budget_gb: float = 6.0):
     """Escalating cached-mode decision shared by the CLI and bench: try
     full-precision (bf16) capture first; if the per-chip budget refuses,
-    retry with the temporal maps stored in float8 (the quadratic-in-frames
-    tree — 8f: 0.6 GiB → 24f: 5.8 GiB at SD scale — halves; e4m3 gives
-    [0,1] probabilities a ~6 % relative step — about one significant
-    decimal digit, with sub-~2e-3 values in subnormals — acceptable
-    because the empirical edit-output delta test (tests/test_cached.py)
-    gates it, and only the edit stream's map replacement reads them,
-    never the exact source replay).
+    retry with the temporal maps stored at one byte per probability — the
+    quadratic-in-frames tree is 8f: 0.6 GiB → 24f: 5.8 GiB at bf16 SD
+    scale, 0.3 GiB → 2.9 GiB at 1 byte. Two 1-byte encodings, tried in
+    order:
+
+      * ``float8_e4m3fn`` (where this jax exposes it): ~6 % relative step
+        on [0,1] probabilities — about one significant decimal digit, with
+        sub-~2e-3 values in subnormals;
+      * ``int8`` fixed-point (always available): ``round(p·127)`` — a
+        UNIFORM 1/254 ≈ 0.004 absolute step, so mid-range probabilities
+        quantize FINER than e4m3 while tiny ones coarser; encode/decode at
+        the capture/replay seams (pipelines/inversion.py ↔
+        ``CachedSource.base_tree_at``).
+
+    Both are acceptable because the empirical edit-output delta test
+    (tests/test_cached.py) gates them, and only the edit stream's map
+    replacement reads them, never the exact source replay.
 
     ``shapes_for(temporal_maps_dtype)`` must return the
     :func:`capture_shapes` CachedSource shape tree for that storage dtype.
@@ -47,7 +57,11 @@ def choose_cached_maps(shapes_for, *, sp: int = 1, budget_gb: float = 6.0):
     """
     import jax.numpy as jnp
 
-    for dt in (None, jnp.float8_e4m3fn):
+    candidates = [None]
+    if hasattr(jnp, "float8_e4m3fn"):
+        candidates.append(jnp.float8_e4m3fn)
+    candidates.append(jnp.int8)
+    for dt in candidates:
         fits, map_gb, per_chip_gb = maps_budget_decision(
             shapes_for(dt), sp=sp, budget_gb=budget_gb
         )
@@ -134,6 +148,7 @@ def cached_fast_edit(
     telemetry: bool = False,
     device_probe: Optional[Callable] = None,
     attn_maps: bool = False,
+    reuse_schedule: Optional[str] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Capture-inversion of ``latents`` under ``cond_src`` followed by the
     cached-source controlled edit under ``cond_all``/``uncond``. Returns
@@ -147,7 +162,11 @@ def cached_fast_edit(
     ``{"inversion": ..., "edit": ...}`` — the source stream's heatmaps from
     the inversion walk plus the edit streams' heatmaps / entropies / blend
     mask series. Return order ``(trajectory, edited[, tel][, dev][, attn])``;
-    all off by default, leaving the program byte-identical."""
+    all off by default, leaving the program byte-identical.
+    ``reuse_schedule`` enables cross-step deep-feature reuse in the edit
+    scan (pipelines/reuse.py) — the inversion capture always runs the full
+    UNet (its maps feed the controllers); "off"/None is pinned
+    byte-identical."""
     inv = ddim_inversion_captured(
         unet_fn, params, scheduler, latents, cond_src,
         num_inference_steps=num_inference_steps,
@@ -171,6 +190,7 @@ def cached_fast_edit(
         telemetry=telemetry,
         device_probe=device_probe,
         attn_maps=attn_maps,
+        reuse_schedule=reuse_schedule,
     )
     if not (telemetry or device_probe is not None or attn_maps):
         return trajectory, edited

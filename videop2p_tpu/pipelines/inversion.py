@@ -285,9 +285,22 @@ def ddim_inversion_captured(
             if want_temporal:
                 t_tree = filter_site_tree(store["attn_base"], "attn_temp")
                 if temporal_maps_dtype is not None:
-                    t_tree = jax.tree.map(
-                        lambda a: a.astype(temporal_maps_dtype), t_tree
-                    )
+                    if jnp.issubdtype(jnp.dtype(temporal_maps_dtype),
+                                      jnp.integer):
+                        # int8 fixed-point: probabilities in [0,1] scale to
+                        # round(p·127) — a uniform 1/254 absolute step;
+                        # CachedSource.base_tree_at divides back by 127
+                        t_tree = jax.tree.map(
+                            lambda a: jnp.clip(
+                                jnp.round(a.astype(jnp.float32) * 127.0),
+                                -127.0, 127.0,
+                            ).astype(temporal_maps_dtype),
+                            t_tree,
+                        )
+                    else:
+                        t_tree = jax.tree.map(
+                            lambda a: a.astype(temporal_maps_dtype), t_tree
+                        )
                 ys["temporal"] = t_tree
             return (latent, key), ys
 

@@ -94,17 +94,38 @@ def _pack_step_outputs(telemetry, tel, attn_maps, attn, dev=None):
 
 
 def make_unet_fn(model) -> UNetFn:
-    """Adapter from a linen UNet module to the pipeline's callable contract."""
+    """Adapter from a linen UNet module to the pipeline's callable contract.
 
-    def fn(params, sample, t, text, control=None):
+    Quantized parameter trees (``models/quant.py`` :class:`QuantizedTensor`
+    leaves, produced by ``convert.quantize_unet_params`` at load time) are
+    dequantized INSIDE the traced fn to the model's compute dtype — the
+    low-precision weights stay the compiled program's inputs (the
+    bytes-accessed win) and the upcast happens at the matmul seam, the same
+    convention as the float8 temporal-map capture. Unquantized trees pass
+    through untouched, so the off path's program is byte-identical.
+
+    ``deep_mode``/``deep_feature`` forward the DeepCache reuse seam to the
+    model (see :meth:`UNet3DConditionModel.__call__`); the default
+    ``"full"`` call is exactly the pre-reuse adapter.
+    """
+    from videop2p_tpu.models.quant import QuantizedTensor, dequantize_tree
+
+    def fn(params, sample, t, text, control=None, *, deep_mode="full",
+           deep_feature=None):
         # init() also returns sown collections (sow runs during init);
         # passing them back into apply would make sow append a second entry
         # per site — keep only the parameter collections.
         variables = {
             k: v for k, v in params.items() if k not in ("attn_store", "attn_base")
         }
+        if any(isinstance(x, QuantizedTensor) for x in jax.tree_util.tree_leaves(
+                variables, is_leaf=lambda x: isinstance(x, QuantizedTensor))):
+            variables = dequantize_tree(variables, model.dtype)
+        kwargs = ({} if deep_mode == "full"
+                  else {"deep_mode": deep_mode, "deep_feature": deep_feature})
         out, store = model.apply(
-            variables, sample, t, text, control, mutable=["attn_store", "attn_base"]
+            variables, sample, t, text, control,
+            mutable=["attn_store", "attn_base"], **kwargs
         )
         return out, store
 
@@ -133,6 +154,7 @@ def edit_sample(
     telemetry: bool = False,
     device_probe: Optional[Callable] = None,
     attn_maps: bool = False,
+    reuse_schedule: Optional[str] = None,
 ) -> jax.Array:
     """Run the controlled denoise loop; returns final latents (P, F, h, w, C).
 
@@ -197,6 +219,15 @@ def edit_sample(
     return is ``latents`` plus the requested records in fixed order:
     ``(latents[, tel][, dev][, attn])``. Off by default — the capture-off
     program is byte-identical (tests/test_quality.py pins it).
+
+    ``reuse_schedule``: cross-step deep-feature reuse (cached mode only;
+    :mod:`videop2p_tpu.pipelines.reuse`). ``"uniform:K"`` /
+    ``"custom:<p0,p1,...>"`` mark the steps that run the FULL UNet; on the
+    remaining steps the deep down/mid/up stages are skipped and the cached
+    deep feature — carried in the scan state — is reused via a
+    ``lax.cond`` in the scan body, so the whole edit stays ONE compiled
+    program. Incompatible with ``attn_maps`` (shallow steps produce no
+    attention store). ``"off"``/None leaves the scan body byte-identical.
     """
     P = cond_embeddings.shape[0]
     multi = cond_embeddings.ndim == 4
@@ -237,6 +268,18 @@ def edit_sample(
             "step_positions is the cached fast path's step-reduction seam — "
             "it requires cached_source"
         )
+    if reuse_schedule not in (None, "off"):
+        if cached_source is None:
+            raise ValueError(
+                "reuse_schedule is the cached fast path's deep-feature reuse "
+                "seam — it requires cached_source"
+            )
+        if attn_maps:
+            raise ValueError(
+                "attn_maps capture reads every step's attention store and "
+                "shallow reuse steps do not produce one — run attention "
+                "capture with reuse_schedule='off'"
+            )
     if cached_source is not None:
         if source_uses_cfg:
             raise ValueError("cached_source requires fast mode (source_uses_cfg=False)")
@@ -276,6 +319,7 @@ def edit_sample(
             blend_res=blend_res, step_positions=step_positions,
             telemetry=telemetry,
             device_probe=device_probe, attn_maps=attn_maps,
+            reuse_schedule=reuse_schedule,
         )
 
     # the source stream's per-step uncond: the null-text sequence when given,
@@ -451,6 +495,7 @@ def _edit_sample_cached(
     telemetry: bool = False,
     device_probe: Optional[Callable] = None,
     attn_maps: bool = False,
+    reuse_schedule: Optional[str] = None,
 ) -> jax.Array:
     """The cached-source denoise loop: only the P−1 edit streams run the
     UNet; the source stream is read off the reversed inversion trajectory
@@ -566,8 +611,50 @@ def _edit_sample_cached(
             (1 + E,) + edit_maps_shape.shape[1:], edit_maps_shape.dtype
         )
 
+    # cross-step deep-feature reuse (pipelines/reuse.py): the schedule is a
+    # STATIC per-step boolean riding xs; the deep feature (the final up
+    # block's input) and the last full step's blend maps ride the carry, so
+    # the edit stays ONE compiled program regardless of K
+    reuse_full = None
+    if reuse_schedule not in (None, "off"):
+        from videop2p_tpu.pipelines.reuse import parse_reuse_schedule
+
+        reuse_full = parse_reuse_schedule(reuse_schedule, num_inference_steps)
+        if attn_maps:
+            raise ValueError(
+                "attn_maps capture is incompatible with reuse_schedule — "
+                "shallow steps produce no attention store"
+            )
+    deep0 = last_maps0 = None
+    if reuse_full is not None:
+        reuse_control0 = (
+            AttnControl(
+                ctx=ctx, step_index=jnp.asarray(0), num_uncond=U,
+                cached_base=cached.base_tree_at(jnp.asarray(0)),
+                cached_source=True,
+            )
+            if ctx is not None
+            else None
+        )
+        (_, deep_shape), _ = jax.eval_shape(
+            lambda p, x: unet_fn(
+                p, x, timesteps[0], text, reuse_control0, deep_mode="capture"
+            ),
+            params,
+            jnp.concatenate([edit_latents, edit_latents], axis=0),
+        )
+        deep0 = jnp.zeros(deep_shape.shape, deep_shape.dtype)
+        last_maps0 = (
+            jnp.zeros(edit_maps_shape.shape, edit_maps_shape.dtype)
+            if use_blend else jnp.zeros((0,), jnp.float32)
+        )
+
     def body(carry, xs):
-        edit_latents, maps_sum = carry
+        if reuse_full is not None:
+            edit_latents, maps_sum, deep_feat, last_maps = carry
+            *xs, is_full = xs
+        else:
+            edit_latents, maps_sum = carry
         if subset:
             # base_i indexes the captured maps at the mapped base step; the
             # controller's own gates stay in subset-step space (i)
@@ -585,7 +672,49 @@ def _edit_sample_cached(
             if ctx is not None
             else None
         )
-        eps_all, store = unet_fn(params, latent_in, t, text, control)
+        if reuse_full is None:
+            eps_all, store = unet_fn(params, latent_in, t, text, control)
+        else:
+            # both branches trace once; one executes per step. The sown
+            # attention store must NOT cross the cond boundary (the shallow
+            # branch has no deep attention sites, so the pytrees differ) —
+            # the blend maps are reduced from it INSIDE the full branch and
+            # only the fixed-shape reduction crosses.
+            def _cond_maps(store):
+                if not use_blend:
+                    return jnp.zeros((0,), jnp.float32)
+                return blend_maps_from_store(
+                    store,
+                    latent_hw=latent_hw,
+                    video_length=video_length,
+                    num_prompts=E,
+                    text_len=text_len,
+                    blend_res=blend_res,
+                    num_uncond=U,
+                )
+
+            def _full_step(latent_in, deep_feat, last_maps):
+                (eps, deep), store = unet_fn(
+                    params, latent_in, t, text, control, deep_mode="capture"
+                )
+                return (
+                    eps,
+                    deep.astype(deep_feat.dtype),
+                    _cond_maps(store).astype(last_maps.dtype),
+                )
+
+            def _shallow_step(latent_in, deep_feat, last_maps):
+                eps, _ = unet_fn(
+                    params, latent_in, t, text, control,
+                    deep_mode="shallow", deep_feature=deep_feat,
+                )
+                return eps, deep_feat, last_maps
+
+            eps_all, deep_feat, reuse_maps = jax.lax.cond(
+                is_full, _full_step, _shallow_step,
+                latent_in, deep_feat, last_maps,
+            )
+            last_maps = reuse_maps
         eps_uncond, eps_text = eps_all[:E], eps_all[E:]
         eps = eps_uncond + guidance_scale * (eps_text - eps_uncond)
         edit_latents, _ = scheduler.step(
@@ -594,15 +723,21 @@ def _edit_sample_cached(
         )
 
         if use_blend:
-            edit_maps = blend_maps_from_store(
-                store,
-                latent_hw=latent_hw,
-                video_length=video_length,
-                num_prompts=E,
-                text_len=text_len,
-                blend_res=blend_res,
-                num_uncond=U,
-            )
+            if reuse_full is None:
+                edit_maps = blend_maps_from_store(
+                    store,
+                    latent_hw=latent_hw,
+                    video_length=video_length,
+                    num_prompts=E,
+                    text_len=text_len,
+                    blend_res=blend_res,
+                    num_uncond=U,
+                )
+            else:
+                # shallow steps re-add the LAST full step's edit maps — the
+                # same "adjacent steps are nearly identical" premise the
+                # deep-feature reuse itself rests on
+                edit_maps = reuse_maps
             maps_sum = maps_sum + jnp.concatenate([blend_src, edit_maps], axis=0)
             full = jnp.concatenate([src_after, edit_latents], axis=0)
             full = local_blend(full, maps_sum, ctx.blend, i)
@@ -632,6 +767,8 @@ def _edit_sample_cached(
             if use_blend:
                 attn.update(_mask_series_entry(maps_sum, ctx.blend, i, latent_hw))
         ys = _pack_step_outputs(telemetry, tel, attn_maps, attn, dev)
+        if reuse_full is not None:
+            return (edit_latents, maps_sum, deep_feat, last_maps), ys
         return (edit_latents, maps_sum), ys
 
     if cached.blend_seq is None:
@@ -645,7 +782,13 @@ def _edit_sample_cached(
     xs = (timesteps, jnp.arange(num_inference_steps), src_seq, blend_xs)
     if subset:
         xs += (jnp.asarray(positions, jnp.int32), jnp.asarray(prev_ts_np))
-    (edit_latents, _), ys = jax.lax.scan(body, (edit_latents, maps_sum), xs)
+    if reuse_full is not None:
+        xs += (jnp.asarray(reuse_full),)
+        carry0 = (edit_latents, maps_sum, deep0, last_maps0)
+    else:
+        carry0 = (edit_latents, maps_sum)
+    final_carry, ys = jax.lax.scan(body, carry0, xs)
+    edit_latents = final_carry[0]
     # stream 0 = the exact inversion reconstruction (trajectory[0] = x_0)
     out = jnp.concatenate([cached.src_latents[-1], edit_latents], axis=0)
     outs = (out,)

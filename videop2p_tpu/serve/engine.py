@@ -102,7 +102,7 @@ __all__ = ["EditRequest", "EditEngine", "TERMINAL_STATUSES"]
 _REQUEST_FIELDS = (
     "image_path", "prompt", "prompts", "save_name", "is_word_swap",
     "blend_word", "eq_params", "cross_replace_steps", "self_replace_steps",
-    "seed", "steps", "deadline_s", "tenant",
+    "seed", "steps", "deadline_s", "tenant", "quant_mode", "reuse_schedule",
 )
 
 # the machine-readable terminal statuses — everything else is in flight.
@@ -149,6 +149,16 @@ class EditRequest:
     # default (TenantConfig), and the per-tenant accounting in
     # serve_health / /metrics all key on this; "" → "default"
     tenant: str = "default"
+    # per-call cost levers (ISSUE 15). quant_mode is an ASSERTION, not a
+    # request: weights are quantized at program-set build, so the engine
+    # rejects any value other than the set's own mode at admission (HTTP
+    # 400 naming the served mode). reuse_schedule selects a warmed
+    # cross-step deep-feature reuse schedule; like steps, unknown
+    # schedules are rejected at admission (400 with the warmed list)
+    # rather than compiling a cold scan body mid-serve. None = the spec's
+    # defaults.
+    quant_mode: Optional[str] = None
+    reuse_schedule: Optional[str] = None
     frames: Optional[np.ndarray] = None
 
     @classmethod
@@ -184,6 +194,16 @@ class EditRequest:
             )
         if self.tenant is not None and not isinstance(self.tenant, str):
             raise ValueError(f"'tenant' must be a string, got {self.tenant!r}")
+        if self.quant_mode is not None:
+            from videop2p_tpu.models.quant import validate_quant_mode
+
+            validate_quant_mode(self.quant_mode)
+        if self.reuse_schedule is not None and not isinstance(
+            self.reuse_schedule, str
+        ):
+            raise ValueError(
+                f"'reuse_schedule' must be a string, got {self.reuse_schedule!r}"
+            )
 
 
 @dataclass(eq=False)
@@ -197,6 +217,7 @@ class _Prepared:
     args: Tuple  # (cached, cond_all, uncond, ctx, anchor)
     compat: str
     steps: int
+    reuse: str = "off"
     seq: int = 0
     arrival_s: float = 0.0
     deadline_at: Optional[float] = None
@@ -314,8 +335,12 @@ class EditEngine:
         # A shared (already-warm) ProgramSet — replicas in one process —
         # hands its warmed buckets straight to this engine.
         self.warm_steps = {self.spec.steps}
+        # same admission contract for reuse schedules: only warmed scan
+        # bodies are served (the spec default is warmed by ProgramSet.warm)
+        self.warm_reuse = {self.spec.reuse_schedule}
         if self.programs.warmed:
             self.warm_steps.update(self.programs.warmed.get("steps", []))
+            self.warm_reuse.update(self.programs.warmed.get("reuse", []))
         self.store = InversionStore(store_budget_bytes, persist_dir=persist_dir,
                                     faults=self.faults)
         self._spec_fp = self.spec.fingerprint()
@@ -338,18 +363,22 @@ class EditEngine:
     def warm(self, prompts: Sequence[str] = ("a video", "an edited video"),
              *, controller_kwargs: Optional[Dict] = None,
              batch_sizes: Sequence[int] = (2,),
-             step_buckets: Sequence[int] = ()) -> Dict[str, Any]:
+             step_buckets: Sequence[int] = (),
+             reuse_schedules: Sequence[str] = ()) -> Dict[str, Any]:
         """Compile the request path on zeros (see
         :meth:`videop2p_tpu.serve.programs.ProgramSet.warm`); the summary
         lands in the ledger and ``/healthz``. ``step_buckets`` additionally
         warms few-step timestep-subset edit variants — the step counts
-        per-request ``steps`` may then ask for."""
+        per-request ``steps`` may then ask for; ``reuse_schedules`` warms
+        cross-step deep-feature reuse scan bodies the same way for
+        per-request ``reuse_schedule``."""
         info = self.programs.warm(
             prompts, controller_kwargs=controller_kwargs,
             batch_sizes=batch_sizes, dispatch=self.batch_dispatch,
-            step_buckets=step_buckets,
+            step_buckets=step_buckets, reuse_schedules=reuse_schedules,
         )
         self.warm_steps.update(info.get("steps", []))
+        self.warm_reuse.update(info.get("reuse", []))
         self.ledger.event("serve_warm", **info)
         return info
 
@@ -390,6 +419,29 @@ class EditEngine:
                 f"{sorted(self.warm_steps)}) — cold step geometry would "
                 "compile mid-serve; warm it first "
                 "(EditEngine.warm(step_buckets=...) / cli.serve --step_buckets)"
+            )
+        if (request.quant_mode is not None
+                and request.quant_mode != self.spec.quant_mode):
+            raise ValueError(
+                f"quant_mode={request.quant_mode!r} does not match this "
+                f"program set (serving quant_mode={self.spec.quant_mode!r}) — "
+                "weights are quantized at set build, not per request; route "
+                "to a set built with that mode (cli.serve --quant_mode)"
+            )
+        from videop2p_tpu.pipelines.reuse import validate_reuse_schedule
+
+        reuse = (request.reuse_schedule if request.reuse_schedule is not None
+                 else self.spec.reuse_schedule)
+        # grammar first (a malformed schedule gets the grammar error, not
+        # the warm-list one), against the resolved step count
+        reuse = validate_reuse_schedule(reuse, steps)
+        if reuse not in self.warm_reuse:
+            raise ValueError(
+                f"reuse_schedule={reuse!r} is not a warmed schedule (warmed: "
+                f"{sorted(self.warm_reuse)}) — a cold reuse scan body would "
+                "compile mid-serve; warm it first "
+                "(EditEngine.warm(reuse_schedules=...) / cli.serve "
+                "--reuse_buckets)"
             )
         rid = uuid.uuid4().hex[:12]
         now = time.perf_counter()
@@ -937,11 +989,14 @@ class EditEngine:
                     duration_s=dt, rid=rid, store_source=source,
                     steps=steps,
                 )
+            reuse = (request.reuse_schedule
+                     if request.reuse_schedule is not None
+                     else self.spec.reuse_schedule)
             return _Prepared(
-                rid=rid, args=args, steps=steps,
+                rid=rid, args=args, steps=steps, reuse=reuse,
                 compat=compat_key(args, extra=(
                     self._spec_fp, steps, self.spec.guidance_scale,
-                    self.batch_dispatch,
+                    self.batch_dispatch, reuse,
                 )),
                 seq=seq, arrival_s=t0, deadline_at=deadline_at,
                 tenant=tenant,
@@ -960,10 +1015,13 @@ class EditEngine:
         if self.faults is not None:
             self.faults.on_dispatch()
         ps = self.programs
-        # compat keys carry the step count, so a plan is steps-homogeneous
+        # compat keys carry the step count and reuse schedule, so a plan is
+        # homogeneous in both
         steps = plan.items[0].steps
+        reuse = plan.items[0].reuse
         if plan.padded_size == 1:
-            videos, src_err = ps.edit_decode(*plan.items[0].args, steps=steps)
+            videos, src_err = ps.edit_decode(*plan.items[0].args, steps=steps,
+                                             reuse=reuse)
             outs = [(videos, src_err)]
         else:
             stacked = stack_items(
@@ -971,7 +1029,7 @@ class EditEngine:
             )
             videos_b, src_err_b = ps.edit_decode_batch(
                 stacked, plan.padded_size, dispatch=self.batch_dispatch,
-                steps=steps,
+                steps=steps, reuse=reuse,
             )
             outs = unstack_outputs((videos_b, src_err_b), len(plan.items))
         jax.block_until_ready([o[0] for o in outs])

@@ -6,7 +6,11 @@ Endpoints (all JSON):
     ``{"id": ...}`` (202). Clips are server-local paths (``image_path``).
     An optional ``"steps"`` field selects a few-step timestep-subset edit;
     step counts outside the engine's warmed buckets return 400 with the
-    warm list (unknown geometry never compiles cold mid-serve).
+    warm list (unknown geometry never compiles cold mid-serve). The same
+    contract covers the per-call cost knobs: ``"reuse_schedule"`` must be
+    a warmed reuse schedule (400 with the warmed list otherwise) and
+    ``"quant_mode"`` must equal the serving set's build-time mode (400
+    naming it otherwise) — weights quantize at set build, not per request.
   * ``GET  /v1/edits/<id>``      — poll one request's record.
   * ``GET  /v1/edits/<id>/result?wait_s=N`` — block up to N s for a
     terminal record.

@@ -79,6 +79,16 @@ class ProgramSpec:
     tp_collectives: str = "gspmd"
     # serving is the cached fast path: no null-text backward, so no remat
     gradient_checkpointing: bool = False
+    # per-UNet-call cost levers (ISSUE 15). quant_mode quantizes the UNet
+    # weights at SET BUILD time (models/convert.quantize_unet_params) — it
+    # cannot vary per request, only per program set; reuse_schedule is the
+    # spec's DEFAULT cross-step deep-feature reuse (pipelines/reuse.py) and
+    # per-request values are admitted against the warmed-schedule list.
+    # Both enter the fingerprint: a quantized set serves different weights
+    # and a reuse set different scan bodies — sharing a store namespace
+    # with the full-precision set would silently mix outputs
+    quant_mode: str = "off"
+    reuse_schedule: str = "off"
 
     def resolved(self) -> "ProgramSpec":
         """The tiny-width rule the CLI applies: the tiny VAE downsamples
@@ -102,6 +112,7 @@ class ProgramSpec:
                 "width", "video_len", "steps", "guidance_scale", "tiny",
                 "mixed_precision", "seed", "mesh", "ring_variant",
                 "tp_collectives", "gradient_checkpointing",
+                "quant_mode", "reuse_schedule",
             )},
         )
 
@@ -126,12 +137,24 @@ class ProgramSet:
 
     def __init__(self, spec: ProgramSpec, *, bundle: Any = None):
         from videop2p_tpu.cli.common import build_models, setup_mesh
+        from videop2p_tpu.models.quant import fake_quant_act, validate_quant_mode
         from videop2p_tpu.pipelines import make_unet_fn
+        from videop2p_tpu.pipelines.reuse import validate_reuse_schedule
 
         self.spec = spec = spec.resolved()
+        quant_mode = validate_quant_mode(spec.quant_mode)
+        validate_reuse_schedule(spec.reuse_schedule, spec.steps)
         self.dtype = {"fp16": jnp.bfloat16, "bf16": jnp.bfloat16,
                       "fp32": jnp.float32, "no": jnp.float32}[spec.mixed_precision]
         dp, sp, tp = _parse_mesh(spec.mesh)
+        if quant_mode != "off" and (sp > 1 or tp > 1):
+            raise ValueError(
+                f"quant_mode={quant_mode!r} is not supported on a "
+                "model-parallel mesh — setup_mesh walks the param tree to "
+                "assign shardings and QuantizedTensor leaves would need "
+                "per-leaf (qvalue, scale) sharding rules; serve quantized "
+                "sets on dp-only meshes"
+            )
         if bundle is None:
             bundle = build_models(
                 spec.checkpoint,
@@ -142,6 +165,18 @@ class ProgramSet:
                 gradient_checkpointing=spec.gradient_checkpointing,
             )
         self.bundle = bundle
+        if quant_mode != "off":
+            from videop2p_tpu.models.convert import quantize_unet_params
+
+            if quant_mode == "w8a8":
+                # the a8 half: dynamic per-tensor fake-quant at the
+                # attention Dense boundaries, threaded like row_parallel_dot
+                bundle.unet = bundle.unet.clone(act_quant_fn=fake_quant_act)
+            # the w8 half: 1-byte weights become the program inputs;
+            # make_unet_fn dequantizes inside the trace
+            bundle.unet_params = quantize_unet_params(
+                bundle.unet_params, mode=quant_mode
+            )
         self.mesh = None
         self.data_axis_size = dp
         if sp > 1 or tp > 1:
@@ -367,12 +402,15 @@ class ProgramSet:
         )
 
     def _edit_fn(self, steps: Optional[int] = None,
-                 positions: Optional[Tuple[int, ...]] = None):
+                 positions: Optional[Tuple[int, ...]] = None,
+                 reuse: Optional[str] = None):
         """The per-request edit+decode subcomputation — shared verbatim by
         the singleton program and every batched variant, which is what
         makes scan-mode batching bit-exact vs singleton dispatch.
         ``steps``/``positions``: the timestep-subset fast path (few-step
-        serving from the base-steps inversion products)."""
+        serving from the base-steps inversion products). ``reuse``: a
+        cross-step deep-feature reuse schedule (pipelines/reuse.py) — a
+        STATIC knob baked into the compiled scan body."""
         from videop2p_tpu.models import decode_video
         from videop2p_tpu.pipelines import edit_sample
 
@@ -385,7 +423,7 @@ class ProgramSet:
                 cached.src_latents[0], cond_all, uncond,
                 num_inference_steps=steps, guidance_scale=guidance,
                 ctx=ctx, source_uses_cfg=False, cached_source=cached,
-                step_positions=positions,
+                step_positions=positions, reuse_schedule=reuse,
             )
             vids = decode_video(
                 self.bundle.vae, vp, out.astype(self.dtype), sequential=True
@@ -399,16 +437,31 @@ class ProgramSet:
 
         return fn
 
+    def _resolve_reuse(self, reuse: Optional[str], steps: int) -> str:
+        """Per-call reuse schedule: None defers to the spec default;
+        validated against THIS call's step count (a subset-steps edit has
+        fewer positions for the schedule to land on)."""
+        from videop2p_tpu.pipelines.reuse import validate_reuse_schedule
+
+        if reuse is None:
+            reuse = self.spec.reuse_schedule
+        return validate_reuse_schedule(reuse, steps)
+
     def edit_decode(self, cached, cond_all, uncond, ctx, anchor, *,
-                    steps: Optional[int] = None):
+                    steps: Optional[int] = None,
+                    reuse: Optional[str] = None):
         """One request: cached-source controlled edit + VAE decode as one
         dispatch. Returns ``(videos01 (P,F,H,W,3), src_err scalar)``.
         ``steps`` < the spec's base count runs the timestep-subset fast
         path from the same inversion products (the controller must be
-        built for that step count — :meth:`controller`'s ``steps=``)."""
+        built for that step count — :meth:`controller`'s ``steps=``).
+        ``reuse``: cross-step deep-feature reuse schedule (None → the
+        spec's default) — a distinct compiled program per schedule."""
         from videop2p_tpu.obs import instrumented_jit
+        from videop2p_tpu.pipelines.reuse import reuse_label
 
         steps, positions = self.step_plan(steps)
+        reuse = self._resolve_reuse(reuse, steps)
         if positions is not None and ctx is not None:
             # gate-coverage check BEFORE tracing: ctx enters the program as
             # a traced argument, where the in-pipeline check cannot run
@@ -417,9 +470,12 @@ class ProgramSet:
             check_subset_windows(ctx, cached, positions, steps)
         label = ("serve_edit" if steps == self.spec.steps
                  else f"serve_edit_s{steps}")
-        inner = self._edit_fn(steps, positions)
+        rl = reuse_label(reuse)
+        if rl:
+            label += f"_r{rl}"
+        inner = self._edit_fn(steps, positions, reuse)
         prog = self._program(
-            ("serve_edit", steps, self.spec.guidance_scale),
+            ("serve_edit", steps, self.spec.guidance_scale, reuse),
             lambda: instrumented_jit(inner, program=label),
         )
         return prog(self.bundle.unet_params, self.bundle.vae_params,
@@ -427,7 +483,8 @@ class ProgramSet:
 
     def edit_decode_batch(self, stacked_args, size: int, *,
                           dispatch: str = "scan",
-                          steps: Optional[int] = None):
+                          steps: Optional[int] = None,
+                          reuse: Optional[str] = None):
         """``size`` compatible requests stacked on a leading batch axis →
         one dispatch. ``stacked_args`` is the stacked
         ``(cached, cond_all, uncond, ctx, anchor)`` tree
@@ -444,9 +501,15 @@ class ProgramSet:
 
         if dispatch not in ("scan", "vmap"):
             raise ValueError(f"dispatch must be 'scan' or 'vmap', got {dispatch!r}")
+        from videop2p_tpu.pipelines.reuse import reuse_label
+
         steps, positions = self.step_plan(steps)
-        inner = self._edit_fn(steps, positions)
+        reuse = self._resolve_reuse(reuse, steps)
+        inner = self._edit_fn(steps, positions, reuse)
         suffix = "" if steps == self.spec.steps else f"_s{steps}"
+        rl = reuse_label(reuse)
+        if rl:
+            suffix += f"_r{rl}"
 
         def build():
             def fn(params, vp, stacked):
@@ -461,7 +524,7 @@ class ProgramSet:
 
         prog = self._program(
             ("serve_edit_batch", size, dispatch,
-             steps, self.spec.guidance_scale),
+             steps, self.spec.guidance_scale, reuse),
             build,
         )
         stacked_args = self._shard_batch(stacked_args, size)
@@ -492,6 +555,7 @@ class ProgramSet:
         batch_sizes: Sequence[int] = (),
         dispatch: str = "scan",
         step_buckets: Sequence[int] = (),
+        reuse_schedules: Sequence[str] = (),
     ) -> Dict[str, Any]:
         """Compile (and execute once, on zeros) the request-path programs:
         encode → invert-capture → edit+decode, plus any batched variants
@@ -501,7 +565,10 @@ class ProgramSet:
         count / controller structure); mismatched requests still work,
         they just pay their own first compile. Returns a summary the
         ``/healthz`` endpoint reports (``steps`` is the warmed-bucket list
-        the engine admits per-request ``steps`` against)."""
+        the engine admits per-request ``steps`` against; ``reuse`` the
+        warmed reuse-schedule list — the spec default plus
+        ``reuse_schedules`` — admitted the same way; ``quant`` the set's
+        one-and-only quant mode, fixed at build)."""
         t0 = time.perf_counter()
         spec = self.spec
         ctx = self.controller(prompts, **dict(controller_kwargs or {}))
@@ -539,11 +606,22 @@ class ProgramSet:
                 cached, cond_all, uncond, ctx_s, anchor, steps=s
             )[0])
             warmed_steps.add(s)
+        warmed_reuse = {self._resolve_reuse(None, spec.steps)}
+        for r in reuse_schedules:
+            r = self._resolve_reuse(str(r), spec.steps)
+            if r in warmed_reuse:
+                continue
+            jax.block_until_ready(self.edit_decode(
+                cached, cond_all, uncond, ctx, anchor, reuse=r
+            )[0])
+            warmed_reuse.add(r)
         self.warmed = {
             "seconds": round(time.perf_counter() - t0, 3),
             "prompts": list(prompts),
             "batch_sizes": sorted({1, *[int(s) for s in batch_sizes]}),
             "steps": sorted(warmed_steps),
+            "reuse": sorted(warmed_reuse),
+            "quant": spec.quant_mode,
             "src_err": float(np.asarray(jax.device_get(src_err))),
         }
         return self.warmed

@@ -194,6 +194,9 @@ class ReplicaSupervisor:
                 engine.warm_steps.update(
                     (shared.warmed or {}).get("steps", [])
                 )
+                engine.warm_reuse.update(
+                    (shared.warmed or {}).get("reuse", [])
+                )
             server = make_server(engine, host=self.host).start()
             self.replicas.append(Replica(
                 name=name, url=server.url, engine=engine, server=server,
