@@ -949,6 +949,25 @@ def per_call_cost_records(analyses):
     for k, name, a in sorted(reuse):
         records.append(row(name, a, quant_mode="off",
                            reuse_schedule=f"uniform:{k}", calls=k))
+    # the student cost units (ISSUE 16): distill_unit_fp is ONE student
+    # forward (UNet + time head), so its flops_vs_full IS the head's
+    # overhead ratio over the teacher forward; distill_unit_<N> is an
+    # N-step loop-free student walk, so flops_vs_full against N teacher
+    # calls isolates the per-step student-vs-teacher flop ratio — the
+    # latency claim "2-step student ≈ 2/50 of the teacher walk" rests on
+    # this landing every round, even backend_unavailable
+    d = (analyses or {}).get("distill_unit_fp")
+    if isinstance(d, dict):
+        records.append(row("distill_unit_fp", d, quant_mode="off",
+                           reuse_schedule="off", calls=1))
+    distill = []
+    for name, a in (analyses or {}).items():
+        if (isinstance(a, dict) and name.startswith("distill_unit_")
+                and name[len("distill_unit_"):].isdigit()):
+            distill.append((int(name[len("distill_unit_"):]), name, a))
+    for n, name, a in sorted(distill):
+        records.append(row(name, a, quant_mode="off",
+                           reuse_schedule="off", calls=n))
     return records
 
 
@@ -961,6 +980,8 @@ def record_per_call_cost(rec, *, timeout_s=None, ks=PER_CALL_COST_KS) -> None:
         "VIDEOP2P_BENCH_CPU_ANALYSIS_TIMEOUT", "900"))
     programs = ["unet_unit_fp", "unet_unit_w8", "unet_unit_w8a8"]
     programs += [f"reuse_unit_{int(k)}" for k in ks]
+    # student units (ISSUE 16): one student forward + a 2-step student walk
+    programs += ["distill_unit_fp", "distill_unit_2"]
     analyses = collect_cpu_analysis(
         BENCH_FRAMES, BENCH_STEPS, timeout_s=timeout_s, programs=programs,
     )
@@ -1097,7 +1118,7 @@ def build_fast_edit_working_point(*, num_frames: int = 8, num_steps: int = 50,
 
 def run_step_frontier(fn, params, sched, cond, uncond, x0, *,
                       base_steps=50, step_counts=(50, 20, 8), timed=True,
-                      guidance_scale=7.5, variants=()):
+                      guidance_scale=7.5, variants=(), student_head=None):
     """The latency-vs-quality step frontier (ISSUE 8 / ROADMAP item 3):
     from ONE ``base_steps`` captured inversion, run the cached fast edit at
     every requested step count via exact timestep-subset schedules
@@ -1120,10 +1141,19 @@ def run_step_frontier(fn, params, sched, cond, uncond, x0, *,
     knobs: stream 0 is replayed from the cached trajectory, never
     recomputed, so ``src_err`` reads 0.0 regardless of eps precision.
 
+    A variant may also be a 3-tuple ``(student_steps, quant_mode,
+    reuse_schedule)`` (ISSUE 16): the consistency-distilled student row —
+    the cached edit runs at ``student_steps`` subset steps with
+    ``student_head`` (train/distill.py) modulating ε, COMPOSED with the
+    quant/reuse knobs on the same program. Requires ``student_head``
+    (identity-init for the untrained-student baseline, or a distilled
+    head); the source replay stays exact here too.
+
     Returns ``(records, outputs)`` — one JSON-safe record per step count
     (non-finite metric values become null) in base-steps-first order,
-    variant rows last; every record carries ``quant_mode`` and
-    ``reuse_schedule`` (``"off"`` on the plain step rows).
+    variant rows last; every record carries ``quant_mode``,
+    ``reuse_schedule`` (``"off"`` on the plain step rows) and ``student``
+    (False except on student rows).
     """
     import math
 
@@ -1208,6 +1238,7 @@ def run_step_frontier(fn, params, sched, cond, uncond, x0, *,
             "base_steps": base_steps,
             "quant_mode": "off",
             "reuse_schedule": "off",
+            "student": False,
             "edit_s": edit_s,
             "src_err": float(jnp.max(jnp.abs(
                 out[0].astype(jnp.float32) - x0_f
@@ -1239,28 +1270,50 @@ def run_step_frontier(fn, params, sched, cond, uncond, x0, *,
         records.append(rec)
         outputs[steps] = out
 
-    for qm, rs in variants:
-        qm, rs = str(qm), str(rs)
+    for v in variants:
+        if len(v) == 3:
+            stu_steps, qm, rs = int(v[0]), str(v[1]), str(v[2])
+        else:
+            stu_steps, (qm, rs) = 0, (str(v[0]), str(v[1]))
         if qm not in ("off", "w8"):
             raise ValueError(
                 f"frontier quant_mode must be 'off' or 'w8', got {qm!r} "
                 "(w8a8 needs the model rebuilt with act_quant_fn — see the "
                 "unet_unit_w8a8 cost unit)"
             )
-        if qm == "off" and rs == "off":
+        if stu_steps:
+            if student_head is None:
+                raise ValueError(
+                    f"student variant student:{stu_steps}+{qm}+{rs} needs "
+                    "student_head (train/distill.py init_time_head for the "
+                    "untrained-student baseline, or a distilled head)"
+                )
+            if not 1 <= stu_steps <= base_steps:
+                raise ValueError(
+                    f"student steps {stu_steps} outside [1, {base_steps}]"
+                )
+        elif qm == "off" and rs == "off":
             continue  # identical to the base row
+        steps_v = stu_steps or base_steps
+        positions_v = (None if steps_v == base_steps else tuple(
+            int(i) for i in sched.subset_positions(base_steps, steps_v)
+        ))
+        ctx_v = ctx_base if steps_v == base_steps else ctl(steps_v)
+        head_v = student_head if stu_steps else None
         p_v = params
         if qm == "w8":
             from videop2p_tpu.models.convert import quantize_unet_params
             p_v = quantize_unet_params(params, mode=qm)
         prog = jax.jit(
-            lambda p, xt, cch, _rs=(None if rs == "off" else rs):
+            lambda p, xt, cch, _rs=(None if rs == "off" else rs),
+            _ctx=ctx_v, _n=steps_v, _pos=positions_v, _head=head_v:
             edit_sample(
                 fn, p, sched, xt, cond, uncond,
-                num_inference_steps=base_steps,
-                guidance_scale=guidance_scale, ctx=ctx_base,
+                num_inference_steps=_n,
+                guidance_scale=guidance_scale, ctx=_ctx,
                 source_uses_cfg=False, cached_source=cch,
-                reuse_schedule=_rs,
+                step_positions=_pos, reuse_schedule=_rs,
+                student_head=_head,
             )
         )
         out = hard_block(prog(p_v, x_t, cached))
@@ -1271,10 +1324,11 @@ def run_step_frontier(fn, params, sched, cond, uncond, x0, *,
             edit_s = round(time.perf_counter() - t0, 3)
         edit = out[1].astype(jnp.float32)
         rec = {
-            "steps": base_steps,
+            "steps": steps_v,
             "base_steps": base_steps,
             "quant_mode": qm,
             "reuse_schedule": rs,
+            "student": bool(stu_steps),
             "edit_s": edit_s,
             "src_err": float(jnp.max(jnp.abs(
                 out[0].astype(jnp.float32) - x0_f
@@ -1299,7 +1353,8 @@ def run_step_frontier(fn, params, sched, cond, uncond, x0, *,
             rec["background_psnr_db"] = None
             rec["mask_coverage"] = None
         records.append(rec)
-        outputs[f"{qm}+{rs}"] = out
+        outputs[(f"student:{stu_steps}+{qm}+{rs}" if stu_steps
+                 else f"{qm}+{rs}")] = out
     return records, outputs
 
 
@@ -1316,7 +1371,11 @@ def collect_step_frontier(*, timeout_s=900.0, tiny=True, frames=2,
            "--frames", str(frames), "--base_steps", str(base_steps),
            "--steps", ",".join(str(s) for s in step_counts)]
     if variants:
-        cmd += ["--variants", ",".join(f"{qm}+{rs}" for qm, rs in variants)]
+        cmd += ["--variants", ",".join(
+            (f"student:{int(v[0])}+{v[1]}+{v[2]}" if len(v) == 3
+             else f"{v[0]}+{v[1]}")
+            for v in variants
+        )]
     if tiny:
         cmd.append("--tiny")
     env = dict(os.environ)
@@ -1537,7 +1596,10 @@ def record_cpu_only_evidence(repo_dir=None) -> None:
     record_per_call_cost(rec, timeout_s=timeout_s)
     frontier = collect_step_frontier(
         timeout_s=timeout_s, tiny=True,
-        variants=(("w8", "off"), ("off", "uniform:2"), ("w8", "uniform:2")),
+        variants=(("w8", "off"), ("off", "uniform:2"), ("w8", "uniform:2"),
+                  # composed student rows (ISSUE 16): identity-init student
+                  # at 2 subset steps, plain and × quant × reuse
+                  (2, "off", "off"), (2, "w8", "uniform:2")),
     )
     if frontier:
         rec.record("latency_quality_frontier", frontier)
@@ -2553,9 +2615,18 @@ def main() -> None:
             # 50-step inversion via exact timestep subsets, each scored
             # against the full-step edit with the obs/quality metrics —
             # the frontier table docs/PERF_ANALYSIS.md renders
+            # student rows ride the same frontier (ISSUE 16): identity-init
+            # head = the untrained-student baseline, composed with w8+reuse
+            from videop2p_tpu.models import UNet3DConfig
+            from videop2p_tpu.train.distill import init_time_head
+
             frontier, _ = run_step_frontier(
                 fn, params, sched, cond, uncond, x0,
                 base_steps=STEPS, step_counts=(STEPS, 20, 8),
+                variants=((2, "off", "off"), (2, "w8", "uniform:2")),
+                student_head=init_time_head(
+                    jax.random.key(0), UNet3DConfig.sd15()
+                ),
             )
             assert all(r["src_err"] == 0.0 for r in frontier), frontier
             rec.record("latency_quality_frontier", frontier)

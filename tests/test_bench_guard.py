@@ -209,6 +209,27 @@ def test_collect_step_frontier_parses_partial_output(bench, monkeypatch):
     assert [r["steps"] for r in out] == [50, 20]
 
 
+def test_collect_step_frontier_serializes_student_variants(bench, monkeypatch):
+    """ISSUE 16: 3-tuple (student_steps, quant, reuse) variants serialize
+    to the tool's student:N+qm+rs grammar; 2-tuples stay qm+rs."""
+    seen = {}
+
+    def fake_run(cmd, **kw):
+        seen["cmd"] = cmd
+        return types.SimpleNamespace(stdout="", stderr="", returncode=0)
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    bench.collect_step_frontier(
+        timeout_s=1.0,
+        variants=(("w8", "uniform:2"), (2, "off", "off"),
+                  (2, "w8", "uniform:2")),
+    )
+    i = seen["cmd"].index("--variants")
+    assert seen["cmd"][i + 1] == (
+        "w8+uniform:2,student:2+off+off,student:2+w8+uniform:2"
+    )
+
+
 def test_collect_served_latency_parses_record_and_tolerates_failure(
         bench, monkeypatch):
     """ISSUE 14 satellite: the served-latency capture parses the loadgen's
@@ -255,9 +276,9 @@ def test_step_frontier_tool_end_to_end_tiny(bench):
     records = bench.collect_step_frontier(
         timeout_s=560.0, tiny=True, frames=2,
         base_steps=50, step_counts=(50, 20, 8),
-        variants=(("w8", "uniform:2"),),
+        variants=(("w8", "uniform:2"), (2, "w8", "uniform:2")),
     )
-    assert [r["steps"] for r in records] == [50, 20, 8, 50]
+    assert [r["steps"] for r in records] == [50, 20, 8, 50, 2]
     for r in records:
         assert r["base_steps"] == 50
         assert r["src_err"] == 0.0, r          # replay exact at any count
@@ -268,9 +289,13 @@ def test_step_frontier_tool_end_to_end_tiny(bench):
         assert isinstance(r["vs_full_ssim"], float)
         assert r["speedup_vs_full"] is not None
     # the ISSUE 15 variant row: quantized + reuse at full steps, replay
-    # still exact (asserted above), knobs recorded on every row
-    assert [(r["quant_mode"], r["reuse_schedule"]) for r in records] == [
-        ("off", "off"), ("off", "off"), ("off", "off"), ("w8", "uniform:2"),
+    # still exact (asserted above), knobs recorded on every row — and the
+    # ISSUE 16 composed student row (student:2+w8+uniform:2) rides the
+    # same frontier with the student flag recorded on every row
+    assert [(r["quant_mode"], r["reuse_schedule"], r["student"])
+            for r in records] == [
+        ("off", "off", False), ("off", "off", False), ("off", "off", False),
+        ("w8", "uniform:2", False), ("w8", "uniform:2", True),
     ]
 
 
@@ -1177,12 +1202,18 @@ def test_per_call_cost_record_schema(bench):
         "reuse_unit_5": {"flops": 3750, "bytes_accessed": 8000,
                          "argument_bytes": 430, "peak_hbm_bytes": 65},
         "reuse_unit_x": {"flops": 1},   # malformed suffix: ignored
+        "distill_unit_fp": {"flops": 1004, "bytes_accessed": 2010,
+                            "argument_bytes": 404, "peak_hbm_bytes": 51},
+        "distill_unit_2": {"flops": 2008, "bytes_accessed": 4020,
+                           "argument_bytes": 414, "peak_hbm_bytes": 62},
+        "distill_unit_x": {"flops": 1},  # malformed suffix: ignored
         "e2e_cached": {"flops": 9},     # not a per-call unit: ignored
     }
     records = bench.per_call_cost_records(analyses)
     assert [r["program"] for r in records] == [
         "unet_unit_fp", "unet_unit_w8", "unet_unit_w8a8",
         "reuse_unit_2", "reuse_unit_5",
+        "distill_unit_fp", "distill_unit_2",
     ]
     for r in records:
         assert set(r) == set(bench.PER_CALL_COST_FIELDS), r
@@ -1198,6 +1229,13 @@ def test_per_call_cost_record_schema(bench):
     assert by["reuse_unit_5"]["flops_vs_full"] == 0.75   # 3750 / (5*1000)
     assert by["reuse_unit_5"]["bytes_vs_full"] == 0.8    # 8000 / (5*2000)
     assert by["reuse_unit_5"]["argument_bytes_vs_full"] == round(430 / 400, 3)
+    # ISSUE 16: the student units — distill_unit_fp's flops_vs_full IS the
+    # time-head overhead over one teacher call; distill_unit_<N> normalizes
+    # against N teacher calls (per-step student-vs-teacher ratio)
+    assert by["distill_unit_fp"]["calls"] == 1
+    assert by["distill_unit_fp"]["flops_vs_full"] == 1.004  # 1004 / 1000
+    assert by["distill_unit_2"]["calls"] == 2
+    assert by["distill_unit_2"]["flops_vs_full"] == 1.004   # 2008 / (2*1000)
     # fp unit missing → ratios None but rows still land, shape stable
     partial = bench.per_call_cost_records(
         {k: v for k, v in analyses.items() if k != "unet_unit_fp"}

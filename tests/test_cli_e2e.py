@@ -50,6 +50,35 @@ def test_stage1_artifacts(tuned_dir):
     assert os.path.isdir(os.path.join(tuned_dir, "inv_latents"))
 
 
+def test_distillation_after_tuning(tuned_dir):
+    """ISSUE 16: the post-tuning distillation stage trains the few-step
+    student against the tuned teacher on the same clip and writes the
+    servable checkpoint under ``<pipeline>/student/`` — the path
+    ``cli.serve --student_ckpt`` takes — and it loads back against the
+    tuned pipeline's own parameter tree."""
+    import jax.numpy as jnp
+
+    from videop2p_tpu.cli.common import build_models
+    from videop2p_tpu.cli.run_tuning import run_distillation
+    from videop2p_tpu.train import load_student
+
+    ckpt = run_distillation(
+        tuned_dir,
+        {"video_path": "data/rabbit", "prompt": "a rabbit is jumping",
+         "n_sample_frames": 2, "width": 16, "height": 16},
+        distill_steps=2, distill_grid=2, tiny=True, seed=0,
+    )
+    assert os.path.isdir(ckpt)
+    assert os.path.basename(ckpt) == "checkpoint-2"
+    assert os.path.dirname(ckpt) == os.path.join(tuned_dir, "student")
+    bundle = build_models(tuned_dir, dtype=jnp.float32,
+                          frame_attention="chunked", tiny=True)
+    merged, head = load_student(ckpt, bundle.unet_params["params"],
+                                bundle.unet.config)
+    assert head["dense2"]["kernel"].ndim == 2
+    assert jnp.isfinite(head["dense2"]["kernel"].astype(jnp.float32)).all()
+
+
 def test_stage2_fast_edit_with_blend(tuned_dir):
     from videop2p_tpu.cli.run_videop2p import main as p2p
 

@@ -1,0 +1,291 @@
+"""Consistency-distilled few-step student tests (ISSUE 16).
+
+The student is the SAME UNet (the tuner's trainable subset, consistency-
+distilled) plus an external time-conditioning head on ε
+(``train/distill.py``), so the contracts pinned here are:
+
+  * the identity pin — the zero-initialized head is an exact no-op on ε,
+    so at 0 distillation steps the student path is BIT-EXACT with the
+    teacher at the same step subset (the boundary every distilled
+    checkpoint starts from);
+  * the replay pin — stream 0 of the cached edit is concatenated from
+    the captured trajectory and never runs the UNet, so no student can
+    perturb the source replay (``src_err == 0.0`` is structural);
+  * the trainer — ``distill_step``/``distill_steps`` follow the tuner's
+    machinery contract (partitioned trainable subset, frozen majority as
+    a closure constant, fold_in-per-absolute-step keys) with the
+    consistency objective, and ``save_student``/``load_student``
+    round-trip the (trainable, head) checkpoint exactly;
+  * the quality gate — few-step student quality metrics ride the same
+    ``quality`` ledger event QUALITY_RULES diff as quant/reuse
+    (tools/obs_diff.py): the identity student gates clean (exit 0), a
+    corrupted head regresses (exit 1).
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from videop2p_tpu.train.distill import (
+    DistillConfig,
+    DistillState,
+    apply_time_head,
+    distill_step,
+    distill_steps,
+    init_time_head,
+    load_student,
+    make_distill_optimizer,
+    save_student,
+)
+
+STEPS = 5
+SHAPE = (1, 2, 8, 8, 4)  # (B, F, h, w, C)
+
+
+# ------------------------------------------------- the time head --
+
+
+def _tiny_cfg():
+    from videop2p_tpu.models import UNet3DConfig
+
+    return UNet3DConfig.tiny()
+
+
+def test_identity_head_is_value_exact():
+    """The zero-initialized output layer makes apply_time_head the exact
+    identity on ε — scalar and batched timesteps alike — which is what
+    makes the untrained student value-exact with the teacher."""
+    head = init_time_head(jax.random.key(0), _tiny_cfg())
+    eps = jax.random.normal(jax.random.key(1), (2,) + SHAPE[1:],
+                            jnp.bfloat16)
+    np.testing.assert_array_equal(
+        np.asarray(apply_time_head(head, eps, jnp.asarray(10))),
+        np.asarray(eps),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(apply_time_head(head, eps, jnp.asarray([10, 700]))),
+        np.asarray(eps),
+    )
+    # a non-zero output layer really modulates (the head has teeth)
+    perturbed = jax.tree.map(lambda x: x, head)
+    perturbed["dense2"]["bias"] = head["dense2"]["bias"] + 0.5
+    assert not np.array_equal(
+        np.asarray(apply_time_head(perturbed, eps, jnp.asarray(10))),
+        np.asarray(eps),
+    )
+
+
+def test_save_load_student_roundtrip():
+    """The checkpoint stores exactly (trainable subset, head) and
+    load_student merges the restored subset back over the caller's frozen
+    majority — values exact both ways."""
+    cfg = _tiny_cfg()
+    params = {
+        "blk": {
+            "attn1": {"to_q": {"kernel": jnp.full((4, 4), 2.0)}},
+            "proj": {"kernel": jnp.zeros((4, 4))},
+        }
+    }
+    head = init_time_head(jax.random.key(0), cfg)
+    dcfg = DistillConfig(max_train_steps=1)
+    tx = make_distill_optimizer(dcfg)
+    state = DistillState.create(params, head, tx, dcfg.trainable_modules)
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        path = save_student(d, state, 3)
+        assert os.path.basename(path) == "checkpoint-3"
+        merged, head2 = load_student(path, params, cfg)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        merged, params,
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        head2, head,
+    )
+
+
+# ------------------------------------------- tiny-model end-to-end --
+
+
+@pytest.fixture(scope="module")
+def sched():
+    from videop2p_tpu.core import DDIMScheduler
+
+    return DDIMScheduler.create_sd()
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    from videop2p_tpu.models import UNet3DConditionModel, UNet3DConfig
+    from videop2p_tpu.pipelines import make_unet_fn
+
+    cfg = UNet3DConfig.tiny()
+    model = UNet3DConditionModel(config=cfg)
+    sample = jax.random.normal(jax.random.key(0), SHAPE)
+    text = jax.random.normal(jax.random.key(1),
+                             (1, 77, cfg.cross_attention_dim))
+    params = jax.jit(model.init)(jax.random.key(2), sample,
+                                 jnp.asarray(10), text)
+    return make_unet_fn(model), params, cfg
+
+
+@pytest.fixture(scope="module")
+def cached_edit(sched, tiny):
+    """One captured inversion shared by the student tests, plus a runner
+    that takes the step count and the student head."""
+    from videop2p_tpu.pipelines import ddim_inversion_captured, edit_sample
+
+    fn, params, cfg = tiny
+    x0 = 0.5 * jax.random.normal(jax.random.key(3), SHAPE)
+    cond = jax.random.normal(jax.random.key(4),
+                             (2, 77, cfg.cross_attention_dim))
+    uncond = jnp.zeros((77, cfg.cross_attention_dim))
+    traj, cached = jax.jit(
+        lambda p, x: ddim_inversion_captured(
+            fn, p, sched, x, cond[:1], num_inference_steps=STEPS,
+            cross_len=0, self_window=(0, 0),
+        )
+    )(params, x0)
+
+    def run(p, *, steps=STEPS, student_head=None, reuse=None):
+        positions = (None if steps == STEPS else tuple(
+            int(i) for i in sched.subset_positions(STEPS, steps)))
+        return jax.jit(
+            lambda pp, xt, c: edit_sample(
+                fn, pp, sched, xt, cond, uncond,
+                num_inference_steps=steps, step_positions=positions,
+                source_uses_cfg=False, cached_source=c,
+                reuse_schedule=reuse, student_head=student_head,
+            )
+        )(p, traj[-1], cached)
+
+    return run, params, x0, cond
+
+
+@pytest.mark.slow
+def test_identity_student_is_teacher_exact_and_replays_source(cached_edit,
+                                                              tiny):
+    """The 0-distill-steps boundary: the identity-initialized student's
+    2-step cached edit is BIT-EXACT with the teacher's 2-step edit, the
+    source replay is exact under the student (and stays exact when the
+    student composes with w8 quant + reuse — the full frontier row)."""
+    from videop2p_tpu.models.convert import quantize_unet_params
+
+    run, params, x0, _ = cached_edit
+    _, _, cfg = tiny
+    head = init_time_head(jax.random.key(0), cfg)
+    teacher2 = run(params, steps=2)
+    student2 = run(params, steps=2, student_head=head)
+    np.testing.assert_array_equal(np.asarray(student2), np.asarray(teacher2))
+    np.testing.assert_array_equal(np.asarray(student2[0]), np.asarray(x0[0]))
+    # the composed row: student × w8 × uniform:2 — replay still exact
+    out = run(quantize_unet_params(params, mode="w8"), steps=2,
+              student_head=head, reuse="uniform:2")
+    np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(x0[0]))
+    assert np.isfinite(np.asarray(out)).all()
+    # a trained (non-identity) head really changes the edit stream but
+    # CANNOT touch the replayed source stream
+    trained = jax.tree.map(lambda x: x, head)
+    trained["dense2"]["bias"] = head["dense2"]["bias"] + 0.1
+    out_t = run(params, steps=2, student_head=trained)
+    np.testing.assert_array_equal(np.asarray(out_t[0]), np.asarray(x0[0]))
+    assert not np.array_equal(np.asarray(out_t[1]), np.asarray(student2[1]))
+
+
+@pytest.mark.slow
+def test_distillation_trains_and_checkpoint_roundtrips(cached_edit, sched,
+                                                       tiny, tmp_path):
+    """A real (tiny) distillation run: finite losses through the scan, a
+    student checkpoint on disk, load_student round-trips it exactly, and
+    the loaded student's 2-step cached edit runs with the source replay
+    still exact."""
+    run, params, x0, cond = cached_edit
+    fn, _, cfg = tiny
+    dcfg = DistillConfig(max_train_steps=2, distill_grid=STEPS,
+                         learning_rate=1e-3)
+    tx = make_distill_optimizer(dcfg)
+    head = init_time_head(jax.random.key(5), cfg)
+    state = DistillState.create(params["params"], head, tx,
+                                dcfg.trainable_modules)
+    latents = x0.astype(jnp.float32)
+    state, loss = distill_step(fn, tx, state, sched, latents, cond[:1],
+                               jax.random.key(6), cfg=dcfg)
+    assert np.isfinite(float(loss))
+    state, losses = distill_steps(fn, tx, state, sched, latents, cond[:1],
+                                  jax.random.key(6), num_steps=2, cfg=dcfg)
+    assert int(state.step) == 3
+    assert np.isfinite(np.asarray(losses)).all()
+    ckpt = save_student(str(tmp_path / "student"), jax.device_get(state), 3)
+    merged, head2 = load_student(ckpt, params["params"], cfg)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        head2, jax.device_get(state.head),
+    )
+    out = run({"params": merged}, steps=2, student_head=head2)
+    np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(x0[0]))
+    assert np.isfinite(np.asarray(out)).all()
+
+
+@pytest.mark.slow
+def test_obs_diff_gates_student_quality(cached_edit, tiny, tmp_path, capsys):
+    """The ISSUE 16 acceptance gate with real metrics: the student's
+    2-step edit is scored against the teacher's full-step output and the
+    numbers ride a ``quality`` ledger event through QUALITY_RULES — the
+    identity student (bit-exact with the teacher's 2-step edit) gates
+    clean against the teacher baseline (exit 0); a corrupted head's
+    collapsed PSNR regresses (exit 1)."""
+    import importlib.util
+
+    from videop2p_tpu.obs import RunLedger
+    from videop2p_tpu.obs.quality import psnr, ssim
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "obs_diff_under_distill_test",
+        os.path.join(repo, "tools", "obs_diff.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    run, params, x0, _ = cached_edit
+    _, _, cfg = tiny
+    head = init_time_head(jax.random.key(0), cfg)
+    reference = np.asarray(run(params)[1])          # teacher, full steps
+    span = float(np.max(reference) - np.min(reference))
+
+    def score(edit):
+        edit = np.asarray(edit)
+        return (
+            float(psnr(jnp.asarray(edit), jnp.asarray(reference),
+                       data_range=span)),
+            float(ssim(jnp.asarray(edit), jnp.asarray(reference),
+                       data_range=span)),
+        )
+
+    def write(path, run_id, edit):
+        db, s = score(edit)
+        led = RunLedger(str(path), run_id=run_id, device_info=False)
+        led.event("quality", recon_psnr=db, background_psnr=30.0,
+                  recon_ssim=s, student=True, steps=2)
+        led.close()
+
+    base = tmp_path / "teacher.jsonl"
+    good = tmp_path / "student.jsonl"
+    bad = tmp_path / "student_bad.jsonl"
+    write(base, "teacher_2step", run(params, steps=2)[1])
+    write(good, "student_2step", run(params, steps=2, student_head=head)[1])
+    broken = jax.tree.map(lambda x: x, head)
+    broken["dense2"]["bias"] = head["dense2"]["bias"] + 10.0
+    write(bad, "student_corrupt",
+          run(params, steps=2, student_head=broken)[1])
+    assert mod.main(["obs_diff.py", str(base), str(good)]) == 0
+    assert mod.main(["obs_diff.py", str(base), str(bad)]) == 1
+    assert "recon_psnr" in capsys.readouterr().out

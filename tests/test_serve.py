@@ -548,6 +548,73 @@ def test_engine_reuse_and_quant_admission(engine):
     assert engine.programs.warmed["quant"] == "off"
 
 
+def test_engine_student_admission_contract(engine):
+    """ISSUE 16: student requests are admitted against the warmed STUDENT
+    buckets, not the teacher's. A set with no student checkpoint rejects
+    every student request at submit with remediation (the HTTP layer maps
+    this to a 400), and the flag itself is shape-validated."""
+    assert engine.warm_student == set()
+    with pytest.raises(ValueError, match="no student checkpoint"):
+        engine.submit(_rabbit_request(student=True))
+    with pytest.raises(ValueError, match="must be a bool"):
+        engine.submit(_rabbit_request(student="yes"))
+
+
+@pytest.mark.slow
+def test_engine_student_warm_serve_identity(programs, tmp_path):
+    """ISSUE 16 end to end at the serving layer: an (identity-init) tiny
+    student checkpoint loads into the set, changes the spec fingerprint,
+    warms its own step buckets, serves a student request with the source
+    replay exact AND bit-identical to the teacher at the same step subset
+    (the 0-distill-steps teacher-identity pin), and an un-warmed student
+    bucket is rejected at submit with the warmed student list."""
+    from videop2p_tpu.serve import EditEngine, ProgramSpec
+    from videop2p_tpu.train.distill import (
+        DistillConfig,
+        DistillState,
+        init_time_head,
+        make_distill_optimizer,
+        save_student,
+    )
+
+    inner = programs.bundle.unet_params["params"]
+    dcfg = DistillConfig(max_train_steps=1)
+    head = init_time_head(jax.random.key(0), programs.bundle.unet.config)
+    state = DistillState.create(inner, head, make_distill_optimizer(dcfg),
+                                dcfg.trainable_modules)
+    ckpt = save_student(str(tmp_path / "student"), jax.device_get(state), 0)
+
+    spec = ProgramSpec(**_SPEC_KW, student_ckpt=ckpt)
+    # the checkpoint is part of the program identity: warm caches and the
+    # inversion store must never collide across student/teacher sets
+    assert spec.fingerprint() != ProgramSpec(**_SPEC_KW).fingerprint()
+
+    eng = EditEngine(spec, out_dir=str(tmp_path / "out"),
+                     persist_dir=str(tmp_path / "inv_store"),
+                     keep_videos=True)
+    try:
+        eng.warm(("a rabbit is jumping", "a origami rabbit is jumping"),
+                 step_buckets=(1,), student_steps=(1,))
+        assert eng.warm_student == {1}
+        assert eng.programs.warmed["student"] == [1]
+
+        r_teacher = eng.submit(_rabbit_request(steps=1))
+        rec_t = eng.result(r_teacher, wait_s=300.0)
+        assert rec_t["status"] == "done", rec_t.get("error")
+        r_student = eng.submit(_rabbit_request(steps=1, student=True))
+        rec_s = eng.result(r_student, wait_s=300.0)
+        assert rec_s["status"] == "done", rec_s.get("error")
+        assert rec_s["src_err"] == 0.0
+        assert rec_s["store_hit"] is True  # same inversion, student or not
+        np.testing.assert_array_equal(eng.videos(r_student),
+                                      eng.videos(r_teacher))
+
+        with pytest.raises(ValueError, match=r"warmed student: \[1\]"):
+            eng.submit(_rabbit_request(steps=2, student=True))
+    finally:
+        eng.close()
+
+
 def test_engine_metrics_report_reservoir_latency(engine):
     m = engine.metrics()
     lat = m["request_latency"]
@@ -604,6 +671,11 @@ def test_http_roundtrip_and_metrics(engine):
         with pytest.raises(RuntimeError, match="400"):
             client.submit({**_rabbit_request().to_dict(),
                            "quant_mode": "w8"})
+        # student request without a student checkpoint / warmed student
+        # bucket -> 400 too (ISSUE 16: the admission contract is
+        # HTTP-pinned)
+        with pytest.raises(RuntimeError, match="400"):
+            client.submit({**_rabbit_request().to_dict(), "student": True})
     finally:
         server.close()
     assert not engine_available(server.url)

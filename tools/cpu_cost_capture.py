@@ -28,6 +28,16 @@ K-step count; a ``lax.cond``'s static analysis would count BOTH branches).
 ``bench.per_call_cost_records`` turns these into the quantization/reuse
 evidence rows.
 
+The STUDENT cost units (ISSUE 16): ``distill_unit_fp`` — one few-step
+student forward (the UNet forward plus the consistency-distilled
+time-conditioning head, ``train/distill.apply_time_head``), whose flop
+delta over ``unet_unit_fp`` IS the head's overhead claim — and
+``distill_unit_<N>`` — N loop-free student forwards (each step with its
+own abstract latent/timestep, same CSE hazard as the reuse units), the
+true N-step student program a ``student:N+...`` frontier row runs. Their
+ratios against the teacher units land in ``bench_details.json`` every
+round, ``backend_unavailable`` included.
+
 Builds the bench's headline programs (the captured inversion, the cached
 2-stream edit, and the fused e2e — the same pipeline calls
 ``bench.build_fast_edit_working_point`` jits) against ABSTRACT inputs
@@ -76,7 +86,7 @@ enable_compile_cache()
 
 
 def build_abstract_programs(frames: int, steps: int, tiny: bool,
-                            reuse_ks=()):
+                            reuse_ks=(), distill_ns=()):
     """(name → (jitted, abstract_args)) for the bench working point, with
     every array an eval_shape/ShapeDtypeStruct — no device execution.
 
@@ -84,7 +94,11 @@ def build_abstract_programs(frames: int, steps: int, tiny: bool,
     to build (one capture forward + K−1 shallow forwards, loop-free — the
     only form whose STATIC cost counts are true per-K-step counts, since
     ``cost_analysis`` counts a ``lax.cond``'s BOTH branches and a scan body
-    once)."""
+    once).
+
+    ``distill_ns``: extra ``distill_unit_<N>`` straight-line few-step
+    student programs (N UNet-forward + time-head steps, loop-free with
+    per-step abstract inputs for the same CSE reason)."""
     from videop2p_tpu.control import make_controller
     from videop2p_tpu.core import DDIMScheduler
     from videop2p_tpu.models import UNet3DConditionModel, UNet3DConfig
@@ -278,6 +292,45 @@ def build_abstract_programs(frames: int, steps: int, tiny: bool,
         programs[f"reuse_unit_{k}"] = (
             make_reuse_unit(k), (params, xs_unit, ts_unit, cond)
         )
+
+    # few-step STUDENT units (ISSUE 16, bench.per_call_cost_records): the
+    # student is the same UNet plus the distilled time-conditioning head
+    # on ε, so one student step = unet_unit_fp + apply_time_head — the
+    # fp-vs-distill flop delta is the head-overhead claim, and the N-step
+    # unit (loop-free, per-step abstract inputs like the reuse units:
+    # shared inputs would let XLA CSE collapse identical forwards) is the
+    # true program a student:N frontier row runs
+    from videop2p_tpu.train.distill import apply_time_head, init_time_head
+
+    head = jax.eval_shape(lambda k: init_time_head(k, cfg),
+                          jax.random.key(0))
+
+    def distill_unit_fp(p, h, x, t, text):
+        eps, _ = fn(p, x, t, text, None)
+        return apply_time_head(h, eps, t)
+
+    programs["distill_unit_fp"] = (
+        jax.jit(distill_unit_fp), (params, head, xt_unit, t_unit, cond)
+    )
+
+    def make_distill_unit(n):
+        def distill_unit(p, h, xs, ts, text):
+            acc = None
+            for i in range(n):
+                eps, _ = fn(p, xs[i], ts[i], text, None)
+                eps = apply_time_head(h, eps, ts[i])
+                acc = eps if acc is None else acc + eps
+            return acc
+        return jax.jit(distill_unit)
+
+    for n in sorted(set(int(n) for n in distill_ns)):
+        if n < 1:
+            raise ValueError(f"distill_unit N must be >= 1, got {n}")
+        xs_unit = jax.ShapeDtypeStruct((n,) + xt_unit.shape, jnp.bfloat16)
+        ts_unit = jax.ShapeDtypeStruct((n,), jnp.int32)
+        programs[f"distill_unit_{n}"] = (
+            make_distill_unit(n), (params, head, xs_unit, ts_unit, cond)
+        )
     return programs
 
 
@@ -362,6 +415,7 @@ def main(argv: List[str]) -> int:
 
     pipeline_wanted = [p for p in wanted if p not in unit_wanted]
     reuse_ks = []
+    distill_ns = []
     for p in pipeline_wanted:
         if p.startswith("reuse_unit_"):
             kpart = p[len("reuse_unit_"):]
@@ -370,12 +424,22 @@ def main(argv: List[str]) -> int:
                       "(want reuse_unit_<K>, K >= 1)", file=sys.stderr)
                 return 2
             reuse_ks.append(int(kpart))
+        elif p.startswith("distill_unit_") and p != "distill_unit_fp":
+            npart = p[len("distill_unit_"):]
+            if not npart.isdigit() or int(npart) < 1:
+                print(f"cpu_cost_capture: bad distill unit name {p!r} "
+                      "(want distill_unit_fp or distill_unit_<N>, N >= 1)",
+                      file=sys.stderr)
+                return 2
+            distill_ns.append(int(npart))
     programs = build_abstract_programs(args.frames, args.steps, args.tiny,
-                                       reuse_ks=reuse_ks)
+                                       reuse_ks=reuse_ks,
+                                       distill_ns=distill_ns)
     unknown = [p for p in pipeline_wanted if p not in programs]
     if unknown:
         print(f"cpu_cost_capture: unknown programs {unknown} "
               f"(have {sorted(programs)} + reuse_unit_<K> + "
+              f"distill_unit_<N> + "
               f"ring_unit_<variant>_f<F> + tp_unit_<gspmd|scatter>)",
               file=sys.stderr)
         return 2

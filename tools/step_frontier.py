@@ -29,6 +29,18 @@ full-step cached edit with int8-quantized weights and/or a DeepCache
 reuse schedule and scored against the full-precision full-step edit.
 The replay-exactness invariant applies to these rows too: ``src_err``
 must stay 0.0 under both knobs.
+
+Student rows (ISSUE 16): a variant may instead be
+``student:<N>+<quant_mode>+<reuse_schedule>`` (e.g.
+``student:2+w8+uniform:2``) — the consistency-distilled few-step
+student at ``N`` steps of the base schedule's exact timestep subset,
+composed with the same quant/reuse knobs. The tool runs these with the
+identity-initialized time-conditioning head (the untrained-student
+baseline, value-exact with the teacher), so the rows prove the composed
+program runs e2e and its ``src_err`` stays 0.0; quality claims for a
+TRAINED student come from the distillation pipeline's ledger through
+``tools/obs_diff.py``. Duplicate ``--variants`` entries are rejected
+(exit 2) rather than silently recorded as duplicate frontier rows.
 """
 
 from __future__ import annotations
@@ -69,20 +81,44 @@ def main(argv: List[str]) -> int:
                         help="skip the timing dispatches (quality only)")
     parser.add_argument("--variants", type=str, default="",
                         help="comma list of quant_mode+reuse_schedule pairs "
-                             "(e.g. w8+off,off+uniform:2,w8+uniform:2)")
+                             "(e.g. w8+off,off+uniform:2,w8+uniform:2) "
+                             "and/or student:N+quant_mode+reuse_schedule "
+                             "rows (e.g. student:2+w8+uniform:2)")
     args = parser.parse_args(argv[1:])
 
     variants = []
+    seen = set()
     for entry in args.variants.split(","):
         entry = entry.strip()
         if not entry:
             continue
-        if "+" not in entry:
+        # the student prefix is checked BEFORE the first-"+" split: a
+        # naive split would hand "student:2" to quant-mode validation
+        # and produce a confusing downstream error
+        if entry.startswith("student:"):
+            parts = entry[len("student:"):].split("+", 2)
+            if len(parts) != 3 or not parts[0].isdigit() or int(parts[0]) < 1:
+                print(f"step_frontier: --variants entry {entry!r} is not "
+                      "student:<N>+<quant_mode>+<reuse_schedule> (N >= 1)",
+                      file=sys.stderr)
+                return 2
+            variant = (int(parts[0]), parts[1], parts[2])
+        elif "+" in entry:
+            qm, rs = entry.split("+", 1)
+            variant = (qm, rs)
+        else:
             print(f"step_frontier: --variants entry {entry!r} is not "
-                  "<quant_mode>+<reuse_schedule>", file=sys.stderr)
+                  "<quant_mode>+<reuse_schedule> or "
+                  "student:<N>+<quant_mode>+<reuse_schedule>",
+                  file=sys.stderr)
             return 2
-        qm, rs = entry.split("+", 1)
-        variants.append((qm, rs))
+        if variant in seen:
+            print(f"step_frontier: duplicate --variants entry {entry!r} — "
+                  "each variant yields one frontier row; a repeat would be "
+                  "silently recorded as a duplicate row", file=sys.stderr)
+            return 2
+        seen.add(variant)
+        variants.append(variant)
 
     import bench
 
@@ -109,11 +145,20 @@ def main(argv: List[str]) -> int:
         jax.random.fold_in(key, 2), x0[:, :2], jnp.asarray(10), cond[:1]
     )
 
+    student_head = None
+    if any(len(v) == 3 for v in variants):
+        # the untrained-student baseline: identity-initialized time head
+        # (zero-init output layer ⇒ value-exact with the teacher forward)
+        from videop2p_tpu.train.distill import init_time_head
+
+        student_head = init_time_head(jax.random.key(0), cfg)
+
     step_counts = [int(s) for s in args.steps.split(",") if s.strip()]
     records, _ = bench.run_step_frontier(
         fn, params, sched, cond, uncond, x0,
         base_steps=args.base_steps, step_counts=step_counts,
         timed=not args.no_time, variants=tuple(variants),
+        student_head=student_head,
     )
     rc = 0
     for rec in records:

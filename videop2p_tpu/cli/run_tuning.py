@@ -436,6 +436,101 @@ def main(
     return output_dir
 
 
+def run_distillation(
+    pipeline_dir: str,
+    train_data: Dict[str, Any],
+    *,
+    distill_steps: int,
+    distill_grid: int = 50,
+    distill_lr: float = 1e-4,
+    distill_ema: float = 0.95,
+    distill_boundary_weight: float = 1.0,
+    tiny: bool = False,
+    seed: Optional[int] = None,
+    steps_per_call: int = 50,
+) -> str:
+    """Consistency-distill the few-step student from a tuned pipeline dir
+    (ISSUE 16 — train/distill.py): the tuned UNet is the frozen teacher,
+    the student re-trains the tuner's parameter subset plus the
+    time-conditioning head against the self-consistency objective on the
+    SAME clip latents the tuning used. Writes the servable student
+    artifact to ``<pipeline_dir>/student/checkpoint-<step>`` — the path
+    ``cli.serve --student_ckpt`` and ``ProgramSpec.student_ckpt`` take.
+    Returns the checkpoint path."""
+    from videop2p_tpu.train import (
+        DistillConfig,
+        DistillState,
+        init_time_head,
+        make_distill_optimizer,
+        save_student,
+    )
+    from videop2p_tpu.train import distill_steps as distill_scan
+
+    n_frames = int(train_data.get("n_sample_frames", 8))
+    bundle = build_models(
+        pipeline_dir, dtype=jnp.float32, frame_attention="chunked",
+        tiny=tiny, seed=seed or 0,
+    )
+    ds = SingleVideoDataset(
+        video_path=train_data["video_path"],
+        prompt=train_data["prompt"],
+        width=int(train_data.get("width", 512)),
+        height=int(train_data.get("height", 512)),
+        n_sample_frames=n_frames,
+        sample_start_idx=int(train_data.get("sample_start_idx", 0)),
+        sample_frame_rate=int(train_data.get("sample_frame_rate", 1)),
+    )
+    video = jnp.asarray(ds.load())[None]
+    key = jax.random.key(seed if seed is not None else 0)
+    key, ek, hk = jax.random.split(key, 3)
+    with phase_timer("vae_encode"):
+        latents = encode_video(
+            bundle.vae, bundle.vae_params, video.astype(jnp.float32), ek
+        )
+        latents = jax.block_until_ready(latents.astype(jnp.float32))
+    text_emb = encode_prompts(bundle, [train_data["prompt"]])
+
+    cfg = DistillConfig(
+        learning_rate=distill_lr,
+        max_train_steps=distill_steps,
+        distill_grid=distill_grid,
+        ema_decay=distill_ema,
+        boundary_weight=distill_boundary_weight,
+    )
+    tx = make_distill_optimizer(cfg)
+    head = init_time_head(hk, bundle.unet.config)
+    state = DistillState.create(
+        bundle.unet_params["params"], head, tx, cfg.trainable_modules
+    )
+    sched = bundle.make_scheduler()  # the DDIM grid the student walks
+    unet_fn = make_unet_fn(bundle.unet)
+    steps_fn = instrumented_jit(
+        lambda s, k, n: distill_scan(
+            unet_fn, tx, s, sched, latents, text_emb, k,
+            num_steps=n, cfg=cfg,
+        ),
+        program="distill_steps",
+        static_argnums=2,
+        donate_argnums=(0,),
+    )
+    key, dk = jax.random.split(key)
+    steps_per_call = max(int(steps_per_call), 1)
+    i, t0 = 0, time.perf_counter()
+    while i < distill_steps:
+        n = min(steps_per_call, distill_steps - i)
+        state, chunk_losses = steps_fn(state, dk, n)
+        i += n
+        loss = float(np.asarray(jax.block_until_ready(chunk_losses))[-1])
+        rate = i / max(time.perf_counter() - t0, 1e-9)
+        print(f"[distill] step {i}/{distill_steps} loss={loss:.5f} "
+              f"({rate:.2f} it/s)")
+    path = save_student(
+        os.path.join(pipeline_dir, "student"), jax.device_get(state), i
+    )
+    print(f"[distill] saved student to {path}")
+    return path
+
+
 def _validate(
     bundle, state, latents, validation_data, output_dir, step, *,
     dependent_weights, sampler, text_emb, key,
@@ -496,6 +591,23 @@ if __name__ == "__main__":
                         help="random-init tiny models (weightless smoke mode)")
     parser.add_argument("--mesh", type=str, default=None,
                         help="device mesh 1,sp,tp (frames/tensor sharding)")
+    # consistency distillation of the few-step student (ISSUE 16 —
+    # train/distill.py; runs AFTER tuning, teacher = the tuned pipeline)
+    parser.add_argument("--distill_steps", type=int, default=0,
+                        help="consistency-distillation steps to run after "
+                             "tuning (0 = off); writes the servable student "
+                             "to <output_dir>/student/checkpoint-<N>")
+    parser.add_argument("--distill_grid", type=int, default=50,
+                        help="DDIM grid points the self-consistency chain "
+                             "walks (the teacher's solver discretization)")
+    parser.add_argument("--distill_lr", type=float, default=1e-4,
+                        help="student learning rate (AdamW via the tuner's "
+                             "partitioned optimizer)")
+    parser.add_argument("--distill_ema", type=float, default=0.95,
+                        help="EMA decay of the consistency target network")
+    parser.add_argument("--distill_boundary_weight", type=float, default=1.0,
+                        help="loss weight of the boundary term (final grid "
+                             "point, target = the data x0)")
     add_dependent_args(parser)
     add_obs_args(parser)
     args = parser.parse_args()
@@ -511,7 +623,7 @@ if __name__ == "__main__":
               "knobs — ignored by the tuning CLI")
     cfg = load_config(args.config)
     args.mesh = args.mesh or cfg.pop("mesh", None)
-    main(
+    out_dir = main(
         **cfg,
         mesh=args.mesh,
         dependent=args.dependent,
@@ -530,3 +642,14 @@ if __name__ == "__main__":
         latency=args.latency,
         trace_analysis=args.trace_analysis,
     )
+    if args.distill_steps > 0:
+        run_distillation(
+            out_dir, cfg["train_data"],
+            distill_steps=args.distill_steps,
+            distill_grid=args.distill_grid,
+            distill_lr=args.distill_lr,
+            distill_ema=args.distill_ema,
+            distill_boundary_weight=args.distill_boundary_weight,
+            tiny=args.tiny,
+            seed=cfg.get("seed"),
+        )

@@ -125,6 +125,20 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--reuse_buckets", type=str, nargs="*", default=[],
                     help="additional reuse schedules to warm; per-request "
                          "'reuse_schedule' outside the warmed set is a 400")
+    # consistency-distilled few-step student (ISSUE 16 — train/distill.py;
+    # docs/PERF_ANALYSIS.md "Few-step student")
+    ap.add_argument("--student_ckpt", type=str, default=None,
+                    help="consistency-distilled student checkpoint "
+                         "(train/distill.py save_student): the distilled "
+                         "trainable subset + time-conditioning head serve "
+                         "requests with 'student': true over the SAME "
+                         "teacher inversion products; enters the spec "
+                         "fingerprint")
+    ap.add_argument("--student_buckets", type=int, nargs="*", default=[],
+                    help="student step buckets to warm (e.g. 1 2 4); a "
+                         "request with 'student': true outside the warmed "
+                         "buckets — or without --student_ckpt — is a 400 "
+                         "listing the warmed options")
     # resilience knobs (ISSUE 9 — docs/SERVING.md "Failure semantics")
     ap.add_argument("--max_queue", type=int, default=64,
                     help="bounded admit queue: over this many in-flight "
@@ -189,6 +203,7 @@ def main(argv=None) -> int:
         mixed_precision=args.mixed_precision, seed=args.seed, mesh=args.mesh,
         ring_variant=args.ring_variant, tp_collectives=args.tp_collectives,
         quant_mode=args.quant_mode, reuse_schedule=args.reuse_schedule,
+        student_ckpt=args.student_ckpt,
     )
     faults = FaultPlan.parse(args.faults) if args.faults else None
     if faults is not None:
@@ -222,11 +237,13 @@ def main(argv=None) -> int:
         info = engine.warm(tuple(args.warm_prompts),
                            batch_sizes=(min(2, args.max_batch),),
                            step_buckets=tuple(args.step_buckets),
-                           reuse_schedules=tuple(args.reuse_buckets))
+                           reuse_schedules=tuple(args.reuse_buckets),
+                           student_steps=tuple(args.student_buckets))
         print(f"[serve] warm in {info['seconds']}s "
               f"(batch sizes {info['batch_sizes']}, "
               f"step buckets {info['steps']}, "
-              f"reuse {info['reuse']}, quant {info['quant']})")
+              f"reuse {info['reuse']}, quant {info['quant']}, "
+              f"student {info['student']})")
     server = make_server(engine, host=args.host, port=args.port)
     print(f"[serve] listening on {server.url}  "
           f"(ledger: {engine.ledger.path})")

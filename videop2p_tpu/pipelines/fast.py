@@ -149,6 +149,7 @@ def cached_fast_edit(
     device_probe: Optional[Callable] = None,
     attn_maps: bool = False,
     reuse_schedule: Optional[str] = None,
+    student_head: Optional[dict] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Capture-inversion of ``latents`` under ``cond_src`` followed by the
     cached-source controlled edit under ``cond_all``/``uncond``. Returns
@@ -166,6 +167,10 @@ def cached_fast_edit(
     ``reuse_schedule`` enables cross-step deep-feature reuse in the edit
     scan (pipelines/reuse.py) — the inversion capture always runs the full
     UNet (its maps feed the controllers); "off"/None is pinned
+    byte-identical. ``student_head`` runs the edit scan as the
+    consistency-distilled student (train/distill.py) — the inversion
+    capture stays the TEACHER's (its maps and trajectory feed the
+    controllers and the exact source replay); None is pinned
     byte-identical."""
     inv = ddim_inversion_captured(
         unet_fn, params, scheduler, latents, cond_src,
@@ -191,6 +196,7 @@ def cached_fast_edit(
         device_probe=device_probe,
         attn_maps=attn_maps,
         reuse_schedule=reuse_schedule,
+        student_head=student_head,
     )
     if not (telemetry or device_probe is not None or attn_maps):
         return trajectory, edited

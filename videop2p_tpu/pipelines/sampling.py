@@ -155,6 +155,7 @@ def edit_sample(
     device_probe: Optional[Callable] = None,
     attn_maps: bool = False,
     reuse_schedule: Optional[str] = None,
+    student_head: Optional[dict] = None,
 ) -> jax.Array:
     """Run the controlled denoise loop; returns final latents (P, F, h, w, C).
 
@@ -228,6 +229,15 @@ def edit_sample(
     ``lax.cond`` in the scan body, so the whole edit stays ONE compiled
     program. Incompatible with ``attn_maps`` (shallow steps produce no
     attention store). ``"off"``/None leaves the scan body byte-identical.
+
+    ``student_head``: the consistency-distilled student's time-conditioning
+    head (:func:`videop2p_tpu.train.distill.apply_time_head` params; cached
+    mode only — the student rides the cached replay at 1–4 subset steps).
+    When set, every edit-stream ε prediction is modulated by the head
+    before CFG and the scheduler step; the source stream is REPLAYED from
+    the capture regardless, so ``src_err == 0.0`` is structurally
+    unaffected. ``None`` (the default) leaves the scan body byte-identical
+    — the student-off program is the pre-distillation program.
     """
     P = cond_embeddings.shape[0]
     multi = cond_embeddings.ndim == 4
@@ -280,6 +290,11 @@ def edit_sample(
                 "shallow reuse steps do not produce one — run attention "
                 "capture with reuse_schedule='off'"
             )
+    if student_head is not None and cached_source is None:
+        raise ValueError(
+            "student_head is the cached fast path's few-step student seam — "
+            "it requires cached_source"
+        )
     if cached_source is not None:
         if source_uses_cfg:
             raise ValueError("cached_source requires fast mode (source_uses_cfg=False)")
@@ -320,6 +335,7 @@ def edit_sample(
             telemetry=telemetry,
             device_probe=device_probe, attn_maps=attn_maps,
             reuse_schedule=reuse_schedule,
+            student_head=student_head,
         )
 
     # the source stream's per-step uncond: the null-text sequence when given,
@@ -496,6 +512,7 @@ def _edit_sample_cached(
     device_probe: Optional[Callable] = None,
     attn_maps: bool = False,
     reuse_schedule: Optional[str] = None,
+    student_head: Optional[dict] = None,
 ) -> jax.Array:
     """The cached-source denoise loop: only the P−1 edit streams run the
     UNet; the source stream is read off the reversed inversion trajectory
@@ -715,6 +732,14 @@ def _edit_sample_cached(
                 latent_in, deep_feat, last_maps,
             )
             last_maps = reuse_maps
+        if student_head is not None:
+            # the few-step student: the distilled time-conditioning head
+            # modulates ε before CFG (train/distill.py). Only the edit
+            # streams run the UNet here — the source stream is replayed
+            # from the capture below, so src_err == 0.0 is untouched.
+            from videop2p_tpu.train.distill import apply_time_head
+
+            eps_all = apply_time_head(student_head, eps_all, t)
         eps_uncond, eps_text = eps_all[:E], eps_all[E:]
         eps = eps_uncond + guidance_scale * (eps_text - eps_uncond)
         edit_latents, _ = scheduler.step(

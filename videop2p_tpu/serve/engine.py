@@ -103,6 +103,7 @@ _REQUEST_FIELDS = (
     "image_path", "prompt", "prompts", "save_name", "is_word_swap",
     "blend_word", "eq_params", "cross_replace_steps", "self_replace_steps",
     "seed", "steps", "deadline_s", "tenant", "quant_mode", "reuse_schedule",
+    "student",
 )
 
 # the machine-readable terminal statuses — everything else is in flight.
@@ -159,6 +160,12 @@ class EditRequest:
     # defaults.
     quant_mode: Optional[str] = None
     reuse_schedule: Optional[str] = None
+    # run the consistency-distilled few-step student (ISSUE 16): the
+    # distilled params + time-conditioning head serve this request over
+    # the same teacher inversion products. Admitted only when the set was
+    # built with a student_ckpt AND the resolved step count is a warmed
+    # student bucket — otherwise 400 listing the warmed options.
+    student: bool = False
     frames: Optional[np.ndarray] = None
 
     @classmethod
@@ -204,6 +211,10 @@ class EditRequest:
             raise ValueError(
                 f"'reuse_schedule' must be a string, got {self.reuse_schedule!r}"
             )
+        if not isinstance(self.student, bool):
+            raise ValueError(
+                f"'student' must be a bool, got {self.student!r}"
+            )
 
 
 @dataclass(eq=False)
@@ -218,6 +229,7 @@ class _Prepared:
     compat: str
     steps: int
     reuse: str = "off"
+    student: bool = False
     seq: int = 0
     arrival_s: float = 0.0
     deadline_at: Optional[float] = None
@@ -338,9 +350,14 @@ class EditEngine:
         # same admission contract for reuse schedules: only warmed scan
         # bodies are served (the spec default is warmed by ProgramSet.warm)
         self.warm_reuse = {self.spec.reuse_schedule}
+        # student buckets start EMPTY — there is no implicit student
+        # geometry; only explicitly warmed (student_ckpt + student_steps)
+        # buckets are admitted
+        self.warm_student: set = set()
         if self.programs.warmed:
             self.warm_steps.update(self.programs.warmed.get("steps", []))
             self.warm_reuse.update(self.programs.warmed.get("reuse", []))
+            self.warm_student.update(self.programs.warmed.get("student", []))
         self.store = InversionStore(store_budget_bytes, persist_dir=persist_dir,
                                     faults=self.faults)
         self._spec_fp = self.spec.fingerprint()
@@ -364,21 +381,26 @@ class EditEngine:
              *, controller_kwargs: Optional[Dict] = None,
              batch_sizes: Sequence[int] = (2,),
              step_buckets: Sequence[int] = (),
-             reuse_schedules: Sequence[str] = ()) -> Dict[str, Any]:
+             reuse_schedules: Sequence[str] = (),
+             student_steps: Sequence[int] = ()) -> Dict[str, Any]:
         """Compile the request path on zeros (see
         :meth:`videop2p_tpu.serve.programs.ProgramSet.warm`); the summary
         lands in the ledger and ``/healthz``. ``step_buckets`` additionally
         warms few-step timestep-subset edit variants — the step counts
         per-request ``steps`` may then ask for; ``reuse_schedules`` warms
         cross-step deep-feature reuse scan bodies the same way for
-        per-request ``reuse_schedule``."""
+        per-request ``reuse_schedule``; ``student_steps`` warms the
+        consistency-distilled student's buckets (requires the spec's
+        ``student_ckpt``) for per-request ``student=True``."""
         info = self.programs.warm(
             prompts, controller_kwargs=controller_kwargs,
             batch_sizes=batch_sizes, dispatch=self.batch_dispatch,
             step_buckets=step_buckets, reuse_schedules=reuse_schedules,
+            student_steps=student_steps,
         )
         self.warm_steps.update(info.get("steps", []))
         self.warm_reuse.update(info.get("reuse", []))
+        self.warm_student.update(info.get("student", []))
         self.ledger.event("serve_warm", **info)
         return info
 
@@ -413,7 +435,27 @@ class EditEngine:
             )
         request.validate()
         steps = int(request.steps) if request.steps else self.spec.steps
-        if steps not in self.warm_steps:
+        if request.student:
+            # student admission replaces the teacher step-bucket check: a
+            # student bucket is its OWN warmed geometry (distilled params +
+            # head program), independent of the teacher buckets
+            if self.programs.student_head is None:
+                raise ValueError(
+                    "student=True but this program set has no student "
+                    "checkpoint — build the set with --student_ckpt "
+                    "(ProgramSpec.student_ckpt) and warm student buckets "
+                    "(EditEngine.warm(student_steps=...) / cli.serve "
+                    "--student_buckets)"
+                )
+            if steps not in self.warm_student:
+                raise ValueError(
+                    f"steps={steps} is not a warmed student bucket (warmed "
+                    f"student: {sorted(self.warm_student)}) — a cold student "
+                    "program would compile mid-serve; warm it first "
+                    "(EditEngine.warm(student_steps=...) / cli.serve "
+                    "--student_buckets)"
+                )
+        elif steps not in self.warm_steps:
             raise ValueError(
                 f"steps={steps} is not a warmed step bucket (warmed: "
                 f"{sorted(self.warm_steps)}) — cold step geometry would "
@@ -992,11 +1034,13 @@ class EditEngine:
             reuse = (request.reuse_schedule
                      if request.reuse_schedule is not None
                      else self.spec.reuse_schedule)
+            student = bool(request.student)
             return _Prepared(
                 rid=rid, args=args, steps=steps, reuse=reuse,
+                student=student,
                 compat=compat_key(args, extra=(
                     self._spec_fp, steps, self.spec.guidance_scale,
-                    self.batch_dispatch, reuse,
+                    self.batch_dispatch, reuse, student,
                 )),
                 seq=seq, arrival_s=t0, deadline_at=deadline_at,
                 tenant=tenant,
@@ -1015,13 +1059,14 @@ class EditEngine:
         if self.faults is not None:
             self.faults.on_dispatch()
         ps = self.programs
-        # compat keys carry the step count and reuse schedule, so a plan is
-        # homogeneous in both
+        # compat keys carry the step count, reuse schedule and student
+        # flag, so a plan is homogeneous in all three
         steps = plan.items[0].steps
         reuse = plan.items[0].reuse
+        student = plan.items[0].student
         if plan.padded_size == 1:
             videos, src_err = ps.edit_decode(*plan.items[0].args, steps=steps,
-                                             reuse=reuse)
+                                             reuse=reuse, student=student)
             outs = [(videos, src_err)]
         else:
             stacked = stack_items(
@@ -1029,7 +1074,7 @@ class EditEngine:
             )
             videos_b, src_err_b = ps.edit_decode_batch(
                 stacked, plan.padded_size, dispatch=self.batch_dispatch,
-                steps=steps, reuse=reuse,
+                steps=steps, reuse=reuse, student=student,
             )
             outs = unstack_outputs((videos_b, src_err_b), len(plan.items))
         jax.block_until_ready([o[0] for o in outs])

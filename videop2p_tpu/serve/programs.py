@@ -89,6 +89,12 @@ class ProgramSpec:
     # with the full-precision set would silently mix outputs
     quant_mode: str = "off"
     reuse_schedule: str = "off"
+    # consistency-distilled few-step student (train/distill.py): path to a
+    # distilled checkpoint (trainable subset + time-conditioning head). In
+    # the fingerprint by CONTENT identity so warm caches and the inversion
+    # store never collide across student/teacher — the inversion itself is
+    # always the TEACHER's (the student rides the same captured replay)
+    student_ckpt: Optional[str] = None
 
     def resolved(self) -> "ProgramSpec":
         """The tiny-width rule the CLI applies: the tiny VAE downsamples
@@ -108,6 +114,8 @@ class ProgramSpec:
             kind="program_spec",
             checkpoint=(content_fingerprint(spec.checkpoint)
                         if spec.checkpoint else "<random-init>"),
+            student_ckpt=(content_fingerprint(spec.student_ckpt)
+                          if spec.student_ckpt else "<none>"),
             **{k: getattr(spec, k) for k in (
                 "width", "video_len", "steps", "guidance_scale", "tiny",
                 "mixed_precision", "seed", "mesh", "ring_variant",
@@ -165,6 +173,28 @@ class ProgramSet:
                 gradient_checkpointing=spec.gradient_checkpointing,
             )
         self.bundle = bundle
+        self.student_params = None
+        self.student_head = None
+        if spec.student_ckpt:
+            if sp > 1 or tp > 1:
+                raise ValueError(
+                    "student_ckpt is not supported on a model-parallel mesh "
+                    "— setup_mesh shards bundle.unet_params only; the "
+                    "student's param tree would stay unsharded and every "
+                    "student dispatch would mix shardings. Serve student "
+                    "sets on dp-only meshes"
+                )
+            # restore against the FULL-PRECISION teacher tree — the student
+            # is the teacher's frozen majority + the distilled trainable
+            # subset + the time-conditioning head; quantization (below)
+            # then applies to both param trees identically
+            from videop2p_tpu.train.distill import load_student
+
+            merged, self.student_head = load_student(
+                spec.student_ckpt, bundle.unet_params["params"],
+                bundle.unet.config,
+            )
+            self.student_params = dict(bundle.unet_params, params=merged)
         if quant_mode != "off":
             from videop2p_tpu.models.convert import quantize_unet_params
 
@@ -177,6 +207,13 @@ class ProgramSet:
             bundle.unet_params = quantize_unet_params(
                 bundle.unet_params, mode=quant_mode
             )
+            if self.student_params is not None:
+                # the student serves the SAME quantized format as the
+                # teacher — student rows on the frontier compose with w8
+                # rather than silently reverting to fp weights
+                self.student_params = quantize_unet_params(
+                    self.student_params, mode=quant_mode
+                )
         self.mesh = None
         self.data_axis_size = dp
         if sp > 1 or tp > 1:
@@ -197,10 +234,19 @@ class ProgramSet:
             from videop2p_tpu.parallel import make_mesh
 
             self.mesh = make_mesh((dp, sp, tp), devices=jax.devices()[:dp])
-            self.bundle.unet_params = jax.device_put(
-                self.bundle.unet_params,
-                jax.sharding.NamedSharding(self.mesh, jax.sharding.PartitionSpec()),
+            replicated = jax.sharding.NamedSharding(
+                self.mesh, jax.sharding.PartitionSpec()
             )
+            self.bundle.unet_params = jax.device_put(
+                self.bundle.unet_params, replicated
+            )
+            if self.student_params is not None:
+                self.student_params = jax.device_put(
+                    self.student_params, replicated
+                )
+                self.student_head = jax.device_put(
+                    self.student_head, replicated
+                )
         self.unet_fn = make_unet_fn(bundle.unet)
         self.scheduler = bundle.make_scheduler()
         self._programs: Dict[Tuple, Callable] = {}
@@ -403,19 +449,31 @@ class ProgramSet:
 
     def _edit_fn(self, steps: Optional[int] = None,
                  positions: Optional[Tuple[int, ...]] = None,
-                 reuse: Optional[str] = None):
+                 reuse: Optional[str] = None,
+                 student: bool = False):
         """The per-request edit+decode subcomputation — shared verbatim by
         the singleton program and every batched variant, which is what
         makes scan-mode batching bit-exact vs singleton dispatch.
         ``steps``/``positions``: the timestep-subset fast path (few-step
         serving from the base-steps inversion products). ``reuse``: a
         cross-step deep-feature reuse schedule (pipelines/reuse.py) — a
-        STATIC knob baked into the compiled scan body."""
+        STATIC knob baked into the compiled scan body. ``student``: run
+        the edit scan as the consistency-distilled student — the head
+        arrays bake in as program constants (a few KiB; one student per
+        spec) while the caller passes the student param tree; the source
+        stream is still the exact capture replay, so ``src_err`` keeps
+        its 0.0 contract."""
         from videop2p_tpu.models import decode_video
         from videop2p_tpu.pipelines import edit_sample
 
         guidance = self.spec.guidance_scale
         steps = int(steps) if steps else self.spec.steps
+        head = self.student_head if student else None
+        if student and head is None:
+            raise ValueError(
+                "student edit requested but the spec has no student_ckpt — "
+                "build the ProgramSet with ProgramSpec.student_ckpt set"
+            )
 
         def fn(params, vp, cached, cond_all, uncond, ctx, anchor):
             out = edit_sample(
@@ -424,6 +482,7 @@ class ProgramSet:
                 num_inference_steps=steps, guidance_scale=guidance,
                 ctx=ctx, source_uses_cfg=False, cached_source=cached,
                 step_positions=positions, reuse_schedule=reuse,
+                student_head=head,
             )
             vids = decode_video(
                 self.bundle.vae, vp, out.astype(self.dtype), sequential=True
@@ -449,14 +508,18 @@ class ProgramSet:
 
     def edit_decode(self, cached, cond_all, uncond, ctx, anchor, *,
                     steps: Optional[int] = None,
-                    reuse: Optional[str] = None):
+                    reuse: Optional[str] = None,
+                    student: bool = False):
         """One request: cached-source controlled edit + VAE decode as one
         dispatch. Returns ``(videos01 (P,F,H,W,3), src_err scalar)``.
         ``steps`` < the spec's base count runs the timestep-subset fast
         path from the same inversion products (the controller must be
         built for that step count — :meth:`controller`'s ``steps=``).
         ``reuse``: cross-step deep-feature reuse schedule (None → the
-        spec's default) — a distinct compiled program per schedule."""
+        spec's default) — a distinct compiled program per schedule.
+        ``student``: dispatch the consistency-distilled student program
+        (distilled params + time-conditioning head) over the SAME teacher
+        inversion products — a distinct compiled program per flag."""
         from videop2p_tpu.obs import instrumented_jit
         from videop2p_tpu.pipelines.reuse import reuse_label
 
@@ -473,18 +536,22 @@ class ProgramSet:
         rl = reuse_label(reuse)
         if rl:
             label += f"_r{rl}"
-        inner = self._edit_fn(steps, positions, reuse)
+        if student:
+            label += "_stu"
+        inner = self._edit_fn(steps, positions, reuse, student)
         prog = self._program(
-            ("serve_edit", steps, self.spec.guidance_scale, reuse),
+            ("serve_edit", steps, self.spec.guidance_scale, reuse, student),
             lambda: instrumented_jit(inner, program=label),
         )
-        return prog(self.bundle.unet_params, self.bundle.vae_params,
+        params = self.student_params if student else self.bundle.unet_params
+        return prog(params, self.bundle.vae_params,
                     cached, cond_all, uncond, ctx, anchor)
 
     def edit_decode_batch(self, stacked_args, size: int, *,
                           dispatch: str = "scan",
                           steps: Optional[int] = None,
-                          reuse: Optional[str] = None):
+                          reuse: Optional[str] = None,
+                          student: bool = False):
         """``size`` compatible requests stacked on a leading batch axis →
         one dispatch. ``stacked_args`` is the stacked
         ``(cached, cond_all, uncond, ctx, anchor)`` tree
@@ -505,11 +572,13 @@ class ProgramSet:
 
         steps, positions = self.step_plan(steps)
         reuse = self._resolve_reuse(reuse, steps)
-        inner = self._edit_fn(steps, positions, reuse)
+        inner = self._edit_fn(steps, positions, reuse, student)
         suffix = "" if steps == self.spec.steps else f"_s{steps}"
         rl = reuse_label(reuse)
         if rl:
             suffix += f"_r{rl}"
+        if student:
+            suffix += "_stu"
 
         def build():
             def fn(params, vp, stacked):
@@ -524,11 +593,12 @@ class ProgramSet:
 
         prog = self._program(
             ("serve_edit_batch", size, dispatch,
-             steps, self.spec.guidance_scale, reuse),
+             steps, self.spec.guidance_scale, reuse, student),
             build,
         )
         stacked_args = self._shard_batch(stacked_args, size)
-        return prog(self.bundle.unet_params, self.bundle.vae_params, stacked_args)
+        params = self.student_params if student else self.bundle.unet_params
+        return prog(params, self.bundle.vae_params, stacked_args)
 
     def _shard_batch(self, stacked_args, size: int):
         """On a serving data mesh, put the batch axis on the ``data`` mesh
@@ -556,6 +626,7 @@ class ProgramSet:
         dispatch: str = "scan",
         step_buckets: Sequence[int] = (),
         reuse_schedules: Sequence[str] = (),
+        student_steps: Sequence[int] = (),
     ) -> Dict[str, Any]:
         """Compile (and execute once, on zeros) the request-path programs:
         encode → invert-capture → edit+decode, plus any batched variants
@@ -568,7 +639,9 @@ class ProgramSet:
         the engine admits per-request ``steps`` against; ``reuse`` the
         warmed reuse-schedule list — the spec default plus
         ``reuse_schedules`` — admitted the same way; ``quant`` the set's
-        one-and-only quant mode, fixed at build)."""
+        one-and-only quant mode, fixed at build; ``student`` the warmed
+        few-step student buckets — requires ``student_ckpt`` on the spec,
+        and per-request ``student=True`` is admitted against it)."""
         t0 = time.perf_counter()
         spec = self.spec
         ctx = self.controller(prompts, **dict(controller_kwargs or {}))
@@ -615,6 +688,24 @@ class ProgramSet:
                 cached, cond_all, uncond, ctx, anchor, reuse=r
             )[0])
             warmed_reuse.add(r)
+        warmed_student: set = set()
+        if student_steps and self.student_head is None:
+            raise ValueError(
+                "student_steps given but the spec has no student_ckpt — "
+                "nothing to warm the student buckets with"
+            )
+        for s in student_steps:
+            s = int(s)
+            if s in warmed_student:
+                continue
+            ctx_s = self.controller(
+                prompts, steps=s, **dict(controller_kwargs or {})
+            ) if s != spec.steps else ctx
+            jax.block_until_ready(self.edit_decode(
+                cached, cond_all, uncond, ctx_s, anchor,
+                steps=s, student=True,
+            )[0])
+            warmed_student.add(s)
         self.warmed = {
             "seconds": round(time.perf_counter() - t0, 3),
             "prompts": list(prompts),
@@ -622,6 +713,7 @@ class ProgramSet:
             "steps": sorted(warmed_steps),
             "reuse": sorted(warmed_reuse),
             "quant": spec.quant_mode,
+            "student": sorted(warmed_student),
             "src_err": float(np.asarray(jax.device_get(src_err))),
         }
         return self.warmed

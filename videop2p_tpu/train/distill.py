@@ -1,0 +1,421 @@
+"""Consistency distillation: the few-step student (ISSUE 16).
+
+The cached edit path already runs fewer steps (timestep subsets, PR 8) and
+cheaper steps (int8 weights / deep-feature reuse, PR 15); this module is the
+remaining ROADMAP-item-1 lever — a Consistency-Models/LCM-style student
+(PAPERS.md: Song et al. 2023, Luo et al. 2023) that collapses the edit to
+1–4 steps outright. It deliberately reuses :mod:`videop2p_tpu.train.tuner`'s
+machinery — the same partitioned-optimizer pattern, the same
+one-``lax.scan`` multi-step driver, the same chunk-invariant
+``fold_in(key, step)`` RNG — and swaps only the objective:
+
+  * the pre-distillation UNet is the frozen **teacher**: its trainable
+    subset is snapshotted at ``DistillState.create`` and never updated;
+  * the **student** is the same UNet with the tuner's parameter subset
+    (``attn1/attn2.to_q``, ``attn_temp``) trainable, plus a small
+    **time-conditioning head** — a zero-initialized per-channel
+    (scale, shift) modulation of ε conditioned on the timestep embedding.
+    Zero init makes the untrained student BIT-EXACT with the teacher
+    (the teacher-identity pin), so distillation only ever moves the model
+    away from a correct starting point;
+  * the loss is **self-consistency along the DDIM trajectory**: for a
+    random grid point t_n, the teacher takes one skip-step DDIM solve
+    x_{t_n} → x_{t_{n−1}}, an EMA **target network** predicts x₀ at the
+    landing point, and the student's x₀ prediction at t_n regresses onto
+    it (stop-gradient). At the trajectory's final grid point the target is
+    the data x₀ itself — the skip-step **boundary condition at x₀** that
+    anchors the whole chain.
+
+Inference needs only ``apply_time_head`` + the distilled parameter subset:
+the student rides the SAME cached controller/attention-map replay
+(:func:`videop2p_tpu.pipelines.sampling.edit_sample` ``student_head=``) at
+1–4 subset steps, so the source stream stays a bit-exact replay
+(``src_err == 0.0``) exactly as for the teacher.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+from videop2p_tpu.core.ddim import DDIMScheduler
+from videop2p_tpu.models.layers import get_timestep_embedding
+from videop2p_tpu.train.checkpoint import restore_checkpoint, save_checkpoint
+from videop2p_tpu.train.masking import (
+    DEFAULT_TRAINABLE,
+    merge_params,
+    partition_params,
+)
+from videop2p_tpu.train.tuner import TuneConfig, make_optimizer
+
+__all__ = [
+    "DistillConfig",
+    "DistillState",
+    "init_time_head",
+    "apply_time_head",
+    "make_distill_optimizer",
+    "distill_step",
+    "distill_steps",
+    "save_student",
+    "load_student",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class DistillConfig:
+    """Distillation hyperparameters (CLI surface: ``--distill*``)."""
+
+    learning_rate: float = 1e-4
+    lr_scheduler: str = "constant"
+    lr_warmup_steps: int = 0
+    max_train_steps: int = 200
+    max_grad_norm: float = 1.0
+    gradient_accumulation_steps: int = 1
+    trainable_modules: Tuple[str, ...] = DEFAULT_TRAINABLE
+    # trajectory discretization: the number of DDIM grid points the
+    # self-consistency chain walks (the teacher's solver grid)
+    distill_grid: int = 50
+    # EMA decay of the target network θ⁻ (Song et al. 2023 use μ≈0.95 at
+    # small scale)
+    ema_decay: float = 0.95
+    # loss weight of the boundary term (grid point N−1, target = data x₀)
+    boundary_weight: float = 1.0
+
+
+def make_distill_optimizer(cfg: DistillConfig) -> optax.GradientTransformation:
+    """The tuner's clipped/accumulating AdamW, driven by the distill
+    hyperparameters — machinery reuse, not duplication."""
+    return make_optimizer(TuneConfig(
+        learning_rate=cfg.learning_rate,
+        lr_scheduler=cfg.lr_scheduler,
+        lr_warmup_steps=cfg.lr_warmup_steps,
+        max_train_steps=cfg.max_train_steps,
+        max_grad_norm=cfg.max_grad_norm,
+        gradient_accumulation_steps=cfg.gradient_accumulation_steps,
+        trainable_modules=cfg.trainable_modules,
+    ))
+
+
+# ------------------------------------------------- time-conditioning head --
+
+
+def init_time_head(key: jax.Array, config) -> dict:
+    """Parameters of the student's time-conditioning head.
+
+    A 2-layer MLP over the UNet's own sinusoidal timestep embedding,
+    producing per-latent-channel ``(scale, shift)``. The OUTPUT layer is
+    zero-initialized, so a fresh head is the identity modulation — the
+    untrained student is bit-exact with the teacher (the same
+    zero-init-residual discipline as the temporal attention's output
+    projection in models/attention.py).
+
+    ``config``: the :class:`~videop2p_tpu.models.unet.UNet3DConfig` the
+    student UNet was built with (fixes embed dim and channel count, so a
+    checkpointed head restores against the right abstract tree).
+    """
+    embed = int(config.block_out_channels[0])
+    hidden = embed
+    channels = int(config.out_channels)
+    scale = 1.0 / jnp.sqrt(jnp.float32(embed))
+    return {
+        "dense1": {
+            "kernel": jax.random.normal(key, (embed, hidden), jnp.float32) * scale,
+            "bias": jnp.zeros((hidden,), jnp.float32),
+        },
+        "dense2": {
+            "kernel": jnp.zeros((hidden, 2 * channels), jnp.float32),
+            "bias": jnp.zeros((2 * channels,), jnp.float32),
+        },
+    }
+
+
+def apply_time_head(head: dict, eps: jax.Array, timestep: jax.Array) -> jax.Array:
+    """ε′ = ε·(1 + scale(t)) + shift(t), per latent channel, fp32 island.
+
+    ``timestep``: () or (B,). A scalar timestep broadcasts the modulation
+    over every stream in ``eps`` (the sampling scan's CFG batch); a (B,)
+    timestep pairs row-for-row with ``eps``'s leading axis (the train
+    step). With a zero-initialized output layer this is exactly ε (the
+    teacher-identity invariant the distill tests pin).
+    """
+    embed = head["dense1"]["kernel"].shape[0]
+    emb = get_timestep_embedding(timestep, embed)  # (1|B, embed) fp32
+    h = jax.nn.silu(
+        emb @ head["dense1"]["kernel"].astype(jnp.float32)
+        + head["dense1"]["bias"].astype(jnp.float32)
+    )
+    out = (h @ head["dense2"]["kernel"].astype(jnp.float32)
+           + head["dense2"]["bias"].astype(jnp.float32))
+    channels = out.shape[-1] // 2
+    scale, shift = out[..., :channels], out[..., channels:]
+    shape = (out.shape[0],) + (1,) * (eps.ndim - 2) + (channels,)
+    scale, shift = scale.reshape(shape), shift.reshape(shape)
+    return (eps.astype(jnp.float32) * (1.0 + scale) + shift).astype(eps.dtype)
+
+
+# --------------------------------------------------------- state / losses --
+
+
+class DistillState(struct.PyTreeNode):
+    """Student/teacher/target split train state.
+
+    ``trainable`` ∪ ``frozen`` is the student UNet; ``teacher_trainable`` ∪
+    ``frozen`` is the frozen teacher (the shared ~90 % majority is stored
+    once); ``ema_*`` is the consistency target network θ⁻.
+    """
+
+    step: jax.Array
+    trainable: Any
+    head: Any
+    frozen: Any
+    teacher_trainable: Any
+    ema_trainable: Any
+    ema_head: Any
+    opt_state: Any
+
+    @classmethod
+    def create(
+        cls,
+        params: Any,
+        head: Any,
+        tx: optax.GradientTransformation,
+        trainable_modules: Sequence[str] = DEFAULT_TRAINABLE,
+    ) -> "DistillState":
+        trainable, frozen = partition_params(params, trainable_modules)
+        copy = lambda t: jax.tree.map(jnp.array, t)  # noqa: E731
+        return cls(
+            step=jnp.asarray(0),
+            trainable=trainable,
+            head=head,
+            frozen=frozen,
+            teacher_trainable=copy(trainable),
+            ema_trainable=copy(trainable),
+            ema_head=copy(head),
+            opt_state=tx.init({"unet": trainable, "head": head}),
+        )
+
+    @property
+    def student_params(self) -> Any:
+        return merge_params(self.trainable, self.frozen)
+
+    @property
+    def teacher_params(self) -> Any:
+        return merge_params(self.teacher_trainable, self.frozen)
+
+
+def _pred_x0(scheduler: DDIMScheduler, eps, t, x):
+    """x₀ from an ε prediction, broadcast-safe over a (B,) timestep (the
+    scheduler's own ``predict_x0_eps`` assumes a scalar t)."""
+    eps, x = eps.astype(jnp.float32), x.astype(jnp.float32)
+    a = scheduler.alphas_cumprod[t]
+    shape = a.shape + (1,) * (x.ndim - a.ndim)
+    a = a.reshape(shape)
+    return (x - jnp.sqrt(1.0 - a) * eps) / jnp.sqrt(a)
+
+
+def _ddim_solve(scheduler: DDIMScheduler, eps, t, t_prev, x):
+    """One deterministic (η=0) DDIM solve x_t → x_{t_prev}, broadcast-safe
+    over (B,) timesteps; ``t_prev < 0`` lands on ``final_alpha_cumprod``
+    exactly like the sampler's terminal step."""
+    eps, x = eps.astype(jnp.float32), x.astype(jnp.float32)
+    a_t = scheduler.alphas_cumprod[t]
+    a_p = jnp.where(
+        t_prev >= 0,
+        scheduler.alphas_cumprod[jnp.clip(t_prev, 0)],
+        scheduler.final_alpha_cumprod,
+    )
+    shape = a_t.shape + (1,) * (x.ndim - a_t.ndim)
+    a_t, a_p = a_t.reshape(shape), a_p.reshape(shape)
+    x0 = (x - jnp.sqrt(1.0 - a_t) * eps) / jnp.sqrt(a_t)
+    return jnp.sqrt(a_p) * x0 + jnp.sqrt(1.0 - a_p) * eps
+
+
+def distill_step(
+    unet_fn,
+    tx: optax.GradientTransformation,
+    state: DistillState,
+    scheduler: DDIMScheduler,
+    latents: jax.Array,
+    text_embeddings: jax.Array,
+    key: jax.Array,
+    *,
+    cfg: DistillConfig,
+    return_grad_norm: bool = False,
+):
+    """One consistency-distillation step on clean latents (B, F, h, w, C).
+
+    Draws a random grid index n per video, noises x₀ to x_{t_n}, solves one
+    teacher DDIM skip-step to x_{t_{n−1}}, and regresses the student's x₀
+    prediction at t_n onto the EMA target network's at the landing point
+    (stop-gradient) — or onto x₀ itself at the final grid point (the
+    boundary condition). Returns ``(new_state, loss[, grad_norm])`` with
+    the tuner's exact telemetry contract.
+    """
+    import numpy as np
+
+    grid = int(cfg.distill_grid)
+    ts_np = np.asarray(scheduler.timesteps(grid))
+    ratio = scheduler.num_train_timesteps // grid
+    ts = jnp.asarray(ts_np)
+    # where step n lands: the next grid timestep; the final step's target
+    # is the terminal ᾱ (t < 0 → final_alpha_cumprod), same rule as the
+    # sampler's own walk
+    prev_ts = jnp.concatenate(
+        [ts[1:], jnp.asarray([int(ts_np[-1]) - ratio], ts.dtype)]
+    )
+
+    noise_key, n_key = jax.random.split(key)
+    noise = jax.random.normal(noise_key, latents.shape, latents.dtype)
+    n = jax.random.randint(n_key, (latents.shape[0],), 0, grid)
+    t_hi = ts[n]
+    t_lo = prev_ts[n]
+    t_lo_in = jnp.maximum(t_lo, 0)  # the EMA net never sees a negative t
+    boundary = t_lo < 0
+    x_hi = scheduler.add_noise(latents, noise, t_hi)
+
+    # frozen teacher skip-step + EMA-target x₀ at the landing point — none
+    # of this depends on the differentiated subtree
+    eps_t = unet_fn(
+        {"params": state.teacher_params}, x_hi, t_hi, text_embeddings, None
+    )[0]
+    x_lo = _ddim_solve(scheduler, eps_t, t_hi, t_lo, x_hi)
+    ema_params = merge_params(state.ema_trainable, state.frozen)
+    eps_e = unet_fn({"params": ema_params}, x_lo, t_lo_in, text_embeddings, None)[0]
+    eps_e = apply_time_head(state.ema_head, eps_e, t_lo_in)
+    x0_e = _pred_x0(scheduler, eps_e, t_lo_in, x_lo)
+    bshape = boundary.shape + (1,) * (latents.ndim - 1)
+    target = jnp.where(
+        boundary.reshape(bshape), latents.astype(jnp.float32), x0_e
+    )
+    target = jax.lax.stop_gradient(target)
+    weight = jnp.where(
+        boundary.reshape(bshape), jnp.float32(cfg.boundary_weight), 1.0
+    )
+
+    def loss_fn(student):
+        params = merge_params(student["unet"], state.frozen)
+        eps_s = unet_fn({"params": params}, x_hi, t_hi, text_embeddings, None)[0]
+        eps_s = apply_time_head(student["head"], eps_s, t_hi)
+        x0_s = _pred_x0(scheduler, eps_s, t_hi, x_hi)
+        return jnp.mean(weight * (x0_s - target) ** 2)
+
+    student = {"unet": state.trainable, "head": state.head}
+    loss, grads = jax.value_and_grad(loss_fn)(student)
+    updates, opt_state = tx.update(grads, state.opt_state, student)
+    student = optax.apply_updates(student, updates)
+    d = jnp.float32(cfg.ema_decay)
+    ema = lambda e, p: (d * e.astype(jnp.float32)  # noqa: E731
+                        + (1.0 - d) * p.astype(jnp.float32)).astype(e.dtype)
+    new_state = DistillState(
+        step=state.step + 1,
+        trainable=student["unet"],
+        head=student["head"],
+        frozen=state.frozen,
+        teacher_trainable=state.teacher_trainable,
+        ema_trainable=jax.tree.map(ema, state.ema_trainable, student["unet"]),
+        ema_head=jax.tree.map(ema, state.ema_head, student["head"]),
+        opt_state=opt_state,
+    )
+    if return_grad_norm:
+        return new_state, loss, optax.global_norm(grads)
+    return new_state, loss
+
+
+def distill_steps(
+    unet_fn,
+    tx: optax.GradientTransformation,
+    state: DistillState,
+    scheduler: DDIMScheduler,
+    latents: jax.Array,
+    text_embeddings: jax.Array,
+    key: jax.Array,
+    *,
+    num_steps: int,
+    cfg: DistillConfig,
+    telemetry: bool = False,
+):
+    """``num_steps`` distillation steps as ONE ``lax.scan`` — the tuner's
+    ``train_steps`` contract verbatim: frozen majority AND the teacher's
+    snapshot enter as closure constants (a carried tree is held twice in
+    the executable), each step's key is ``fold_in(key, absolute_step)`` so
+    chunk boundaries and resume points cannot change the trained student.
+    Returns ``(state, losses[, grad_norms])``.
+    """
+    frozen = state.frozen
+    teacher_trainable = state.teacher_trainable
+
+    def body(carry, _):
+        step, trainable, head, ema_t, ema_h, opt_state = carry
+        s = DistillState(
+            step=step, trainable=trainable, head=head, frozen=frozen,
+            teacher_trainable=teacher_trainable, ema_trainable=ema_t,
+            ema_head=ema_h, opt_state=opt_state,
+        )
+        out = distill_step(
+            unet_fn, tx, s, scheduler, latents, text_embeddings,
+            jax.random.fold_in(key, step),
+            cfg=cfg, return_grad_norm=telemetry,
+        )
+        s = out[0]
+        ys = (out[1], out[2]) if telemetry else out[1]
+        return (
+            (s.step, s.trainable, s.head, s.ema_trainable, s.ema_head,
+             s.opt_state),
+            ys,
+        )
+
+    (step, trainable, head, ema_t, ema_h, opt_state), ys = jax.lax.scan(
+        body,
+        (state.step, state.trainable, state.head, state.ema_trainable,
+         state.ema_head, state.opt_state),
+        None,
+        length=num_steps,
+    )
+    state = DistillState(
+        step=step, trainable=trainable, head=head, frozen=frozen,
+        teacher_trainable=teacher_trainable, ema_trainable=ema_t,
+        ema_head=ema_h, opt_state=opt_state,
+    )
+    if telemetry:
+        losses, grad_norms = ys
+        return state, losses, grad_norms
+    return state, ys
+
+
+# ----------------------------------------------------- student checkpoints --
+
+
+def save_student(output_dir: str, state: DistillState, step: int) -> str:
+    """Write the SERVABLE student artifact — the distilled trainable subset
+    plus the time head — as ``<output_dir>/checkpoint-<step>`` (orbax, the
+    tuner's checkpoint layout)."""
+    return save_checkpoint(
+        output_dir, {"trainable": state.trainable, "head": state.head}, step
+    )
+
+
+def load_student(
+    path: str,
+    params: Any,
+    config,
+    trainable_modules: Sequence[str] = DEFAULT_TRAINABLE,
+) -> Tuple[Any, dict]:
+    """Restore a student artifact against a teacher parameter tree.
+
+    Returns ``(student_params, head)``: the full UNet tree with the
+    distilled subset swapped in over the teacher's frozen majority, and
+    the time-conditioning head. ``config`` is the UNet config (fixes the
+    head's abstract shapes).
+    """
+    trainable, frozen = partition_params(params, trainable_modules)
+    template = {
+        "trainable": trainable,
+        "head": init_time_head(jax.random.key(0), config),
+    }
+    restored = restore_checkpoint(path, template)
+    return merge_params(restored["trainable"], frozen), restored["head"]
