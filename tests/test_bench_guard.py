@@ -511,7 +511,10 @@ def test_report_and_obs_import_only_stdlib_numpy_jax():
     banned = {"matplotlib", "PIL", "imageio", "cv2", "torch", "torchvision",
               "pandas", "seaborn", "plotly", "scipy", "skimage",
               "tensorflow", "flax", "optax", "transformers"}
-    files = [os.path.join(_REPO, "tools", "edit_report.py")]
+    files = [os.path.join(_REPO, "tools", "edit_report.py"),
+             # ISSUE 17 pin: the fleet dashboard renders on any box the
+             # collector runs on — stdlib+numpy SVG, no plotting stack
+             os.path.join(_REPO, "tools", "fleet_dash.py")]
     obs_dir = os.path.join(_REPO, "videop2p_tpu", "obs")
     obs_files = sorted(f for f in os.listdir(obs_dir) if f.endswith(".py"))
     # ISSUE 6 pins: the time-domain modules are IN the guarded set — the
@@ -520,8 +523,12 @@ def test_report_and_obs_import_only_stdlib_numpy_jax():
     # ISSUE 14 pins: the tracing/SLO/exposition tier joins — span
     # emission, budget math and the Prometheus renderer must run on any
     # box the engine does (no opentelemetry/prometheus_client deps)
+    # ISSUE 17 pins: the telemetry plane joins — the time-series store
+    # and the signal engine must never grow a prometheus_client/pandas
+    # path; the fleet ships its own tsdb
     assert {"timing.py", "trace.py",
-            "spans.py", "slo.py", "prom.py"} <= set(obs_files)
+            "spans.py", "slo.py", "prom.py",
+            "tsdb.py", "signals.py"} <= set(obs_files)
     files += [os.path.join(obs_dir, f) for f in obs_files]
     # ISSUE 7 pins: the serving subsystem is IN the guarded set — the
     # HTTP layer stays stdlib http.server/urllib (no flask/requests), and
@@ -534,9 +541,11 @@ def test_report_and_obs_import_only_stdlib_numpy_jax():
     # ISSUE 11 pin: the fleet tier (pluggable schedulers, the replica
     # supervisor and the router) joins too — the router must deploy on any
     # box with nothing beyond the stdlib HTTP stack
+    # ISSUE 17 pin: the scrape loop joins — the collector must deploy on
+    # any box the router does (stdlib urllib probes, no requests)
     assert {"engine.py", "store.py", "batching.py", "programs.py",
             "http.py", "client.py", "faults.py", "sched.py", "replica.py",
-            "router.py"} <= set(serve_files)
+            "router.py", "collector.py"} <= set(serve_files)
     files += [os.path.join(serve_dir, f) for f in serve_files]
     # ISSUE 12 pin: the streaming tier (window plan, resumable manifest,
     # job driver) joins the guarded set — resume/chaos machinery must run
@@ -813,6 +822,72 @@ def test_span_and_slo_report_ledger_event_schema(tmp_path):
     # pre-PR-14 ledgers extract empty (but present) sections
     old = extract_run([{"event": "run_start"}])
     assert old["segments"] == {} and old["slo"] == {}
+
+
+def test_fleet_signals_and_series_ledger_event_schema(tmp_path):
+    """Schema pin (ISSUE 17): the ``fleet_signals`` and ``fleet_series``
+    ledger events carry their documented field sets, SIGNAL_RULES ride in
+    DEFAULT_RULES (kind "signal"), and obs/history.py extracts the new
+    `signals` section — tools/obs_diff.py's fleet table and exit-1 teeth
+    key on these names."""
+    from videop2p_tpu.obs import RunLedger, read_ledger
+    from videop2p_tpu.obs.history import (
+        DEFAULT_RULES,
+        SIGNAL_RULES,
+        extract_run,
+        split_runs,
+    )
+    from videop2p_tpu.obs.signals import (
+        FLEET_SIGNALS_FIELDS,
+        FLEET_TENANT_FIELDS,
+        S_IN_FLIGHT,
+        S_QUEUE_DEPTH,
+        S_REQUESTS,
+        S_TENANT,
+        S_UP,
+        SignalEngine,
+    )
+    from videop2p_tpu.obs.tsdb import FLEET_SERIES_FIELDS, TimeSeriesStore
+
+    assert all(r in DEFAULT_RULES for r in SIGNAL_RULES)
+    assert all(r.kind == "signal" for r in SIGNAL_RULES)
+    assert {r.metric for r in SIGNAL_RULES} == {
+        "burn_alerts", "scrape_error_rate", "saturation"}
+
+    # a minimal degraded fleet: one replica, 50% of finished requests
+    # erroring — both burn windows blow the 1% objective, alert fires
+    ts = TimeSeriesStore(capacity=64)
+    eng = SignalEngine(ts, window_scale=0.01)  # fast 3 s / slow 36 s
+    lab = {"replica": "replica0"}
+    for i in range(6):
+        t = float(i)
+        ts.add(S_UP, t, 1.0, lab)
+        ts.add(S_QUEUE_DEPTH, t, 1.0, lab)
+        ts.add(S_IN_FLIGHT, t, 1.0, lab)
+        ts.add(S_REQUESTS, t, float(i), {**lab, "status": "done"})
+        ts.add(S_REQUESTS, t, float(i), {**lab, "status": "error"})
+        ts.add(S_TENANT, t, float(i),
+               {**lab, "tenant": "A", "field": "submitted"})
+        ts.add(S_TENANT, t, float(i), {**lab, "tenant": "A", "field": "done"})
+    path = str(tmp_path / "ledger.jsonl")
+    with RunLedger(path) as led:
+        rec = eng.evaluate(5.5, ledger=led)
+        ts.snapshot(led, label="fleet",
+                    sidecar_path=str(tmp_path / "series.npz"))
+    assert set(rec) == set(FLEET_SIGNALS_FIELDS)
+    assert rec["burn_alert"] is True and rec["scale_advice"] == "grow"
+    assert set(rec["tenants"]["A"]) == set(FLEET_TENANT_FIELDS)
+    by_kind = {e["event"]: e for e in read_ledger(path)}
+    assert set(FLEET_SIGNALS_FIELDS) <= set(by_kind["fleet_signals"])
+    assert set(FLEET_SERIES_FIELDS) <= set(by_kind["fleet_series"])
+    run = extract_run(split_runs(read_ledger(path))[-1])
+    sig = run["signals"]
+    assert sig["fleet"]["burn_alerts"] == 1.0
+    assert sig["fleet"]["advice_grow"] == 1.0
+    assert sig["fleet:tenant:A"]["submitted_rate"] > 0.0
+    assert sig["fleet:series"]["samples"] > 0.0
+    # pre-PR-17 ledgers extract an empty (but present) signals section
+    assert extract_run([{"event": "run_start"}])["signals"] == {}
 
 
 def test_router_and_tenant_ledger_event_schema(tmp_path):

@@ -177,12 +177,17 @@ def test_run_history_scan_skips_rotated_segments(tmp_path):
 
 def test_prometheus_golden_format():
     """Byte-for-byte pin of the text exposition (version 0.0.4): sorted
-    metrics, labeled fan-out sections, bools as 1/0, non-finite literals,
-    strings skipped."""
+    metrics, one # HELP + # TYPE pair per metric (ISSUE 17), labeled
+    fan-out sections, bools as 1/0, non-finite literals, strings
+    skipped."""
     from videop2p_tpu.obs.prom import (
         PROMETHEUS_CONTENT_TYPE,
         render_prometheus,
     )
+
+    def _hdr(name):
+        return (f"# HELP {name} videop2p /metrics gauge.\n"
+                f"# TYPE {name} gauge\n")
 
     metrics = {
         "warm": True,
@@ -196,29 +201,29 @@ def test_prometheus_golden_format():
         "inf_gauge": float("inf"),
     }
     assert render_prometheus(metrics) == (
-        "# TYPE videop2p_compile_events gauge\n"
-        "videop2p_compile_events 4\n"
-        "# TYPE videop2p_compile_total_s gauge\n"
-        "videop2p_compile_total_s 1.25\n"
-        "# TYPE videop2p_inf_gauge gauge\n"
-        "videop2p_inf_gauge +Inf\n"
-        "# TYPE videop2p_queue_depth gauge\n"
-        "videop2p_queue_depth 2\n"
-        "# TYPE videop2p_replica_healthy gauge\n"
-        'videop2p_replica_healthy{replica="r0"} 1\n'
-        "# TYPE videop2p_replica_nan_gauge gauge\n"
-        'videop2p_replica_nan_gauge{replica="r0"} NaN\n'
-        "# TYPE videop2p_replica_requests_total gauge\n"
-        'videop2p_replica_requests_total{replica="r0",status="done"} 3\n'
-        "# TYPE videop2p_requests_total gauge\n"
-        'videop2p_requests_total{status="done"} 3\n'
-        'videop2p_requests_total{status="error"} 1\n'
-        "# TYPE videop2p_tenant_error_rate gauge\n"
-        'videop2p_tenant_error_rate{tenant="a"} 0\n'
-        "# TYPE videop2p_tenant_requests gauge\n"
-        'videop2p_tenant_requests{tenant="a"} 2\n'
-        "# TYPE videop2p_warm gauge\n"
-        "videop2p_warm 1\n"
+        _hdr("videop2p_compile_events")
+        + "videop2p_compile_events 4\n"
+        + _hdr("videop2p_compile_total_s")
+        + "videop2p_compile_total_s 1.25\n"
+        + _hdr("videop2p_inf_gauge")
+        + "videop2p_inf_gauge +Inf\n"
+        + _hdr("videop2p_queue_depth")
+        + "videop2p_queue_depth 2\n"
+        + _hdr("videop2p_replica_healthy")
+        + 'videop2p_replica_healthy{replica="r0"} 1\n'
+        + _hdr("videop2p_replica_nan_gauge")
+        + 'videop2p_replica_nan_gauge{replica="r0"} NaN\n'
+        + _hdr("videop2p_replica_requests_total")
+        + 'videop2p_replica_requests_total{replica="r0",status="done"} 3\n'
+        + _hdr("videop2p_requests_total")
+        + 'videop2p_requests_total{status="done"} 3\n'
+        + 'videop2p_requests_total{status="error"} 1\n'
+        + _hdr("videop2p_tenant_error_rate")
+        + 'videop2p_tenant_error_rate{tenant="a"} 0\n'
+        + _hdr("videop2p_tenant_requests")
+        + 'videop2p_tenant_requests{tenant="a"} 2\n'
+        + _hdr("videop2p_warm")
+        + "videop2p_warm 1\n"
     )
     assert render_prometheus({}) == ""
     assert "version=0.0.4" in PROMETHEUS_CONTENT_TYPE

@@ -1,0 +1,269 @@
+"""Render a fleet-collector ledger as a self-contained HTML dashboard.
+
+Usage:  python tools/fleet_dash.py <collector_ledger.jsonl> [--out dash.html]
+                                   [--title TITLE]
+
+The telemetry plane's human face (ISSUE 17): reads the ``fleet_signals``
+evaluations and the ``fleet_series`` tsdb snapshot a
+:class:`videop2p_tpu.serve.collector.FleetCollector` run left behind
+(``tools/serve_loadgen.py --collector`` wires one up) and renders:
+
+  * **burn gauges** — the last evaluation's fast/slow-window burn rates
+    as bars against the alert threshold, plus the burn history;
+  * **scale-advice timeline** — one colored cell per evaluation
+    (grow/hold/shrink) so a degraded window is visible at a glance;
+  * **per-series sparklines** — every series in the ``fleet_series``
+    ``.npz`` sidecar (queue depth, in-flight, latency percentiles,
+    per-status request counters, per-tenant meters, scrape health) with
+    gap markers preserved — a dead replica's outage shows as a hole,
+    never an interpolated line;
+  * **per-tenant demand table** — submitted/served/shed rates and
+    device-seconds per lane from the last evaluation.
+
+Everything is inline (CSS + SVG, no external assets) — the output ships
+in a bug report. Tolerates signal-only ledgers (no snapshot event → no
+sparkline section) and pre-PR-17 ledgers (renders an empty-state page).
+
+stdlib+numpy+videop2p_tpu only — the import-guard test walks this file.
+"""
+
+from __future__ import annotations
+
+import html
+import math
+import os
+import sys
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from videop2p_tpu.obs.ledger import read_ledger  # noqa: E402
+from videop2p_tpu.obs.report import (  # noqa: E402
+    _CSS,
+    _fmt,
+    _last_run,
+    _svg_spark,
+    _table,
+)
+from videop2p_tpu.obs.tsdb import load_series_sidecar  # noqa: E402
+
+_ADVICE_COLOR = {"grow": "#b22222", "hold": "#999999", "shrink": "#2a7ab8"}
+
+
+def _burn_gauge(label: str, burn: float, threshold: float,
+                w: int = 320, h: int = 22) -> str:
+    """One horizontal burn bar: fill is burn relative to 3x threshold,
+    red past the threshold tick."""
+    burn = max(float(burn or 0.0), 0.0)
+    thr = max(float(threshold), 1e-9)
+    cap = 3.0 * thr
+    frac = min(burn / cap, 1.0)
+    tick = min(thr / cap, 1.0)
+    color = "#b22222" if burn > thr else "#2a7a2a"
+    return (
+        f'<div class=row><svg width="{w}" height="{h}">'
+        f'<rect x="0" y="3" width="{w - 70}" height="{h - 6}" '
+        f'fill="#eee" stroke="#ccc"/>'
+        f'<rect x="0" y="3" width="{frac * (w - 70):.1f}" height="{h - 6}" '
+        f'fill="{color}"/>'
+        f'<line x1="{tick * (w - 70):.1f}" y1="0" '
+        f'x2="{tick * (w - 70):.1f}" y2="{h}" stroke="#333" '
+        f'stroke-dasharray="2,2"/>'
+        f'<text x="{w - 64}" y="{h - 7}" font-size="11">{burn:.2f}x</text>'
+        f'</svg><span class=meta> {html.escape(label)} '
+        f'(alert past {threshold:g})</span></div>'
+    )
+
+
+def _advice_timeline(sigs: Sequence[Dict[str, Any]], w_cell: int = 14,
+                     h: int = 26) -> str:
+    if not sigs:
+        return ""
+    cells = []
+    for i, e in enumerate(sigs):
+        advice = str(e.get("scale_advice", "?"))
+        color = _ADVICE_COLOR.get(advice, "#e0c040")
+        title = (f"eval {i}: {advice}"
+                 + (f" — {'; '.join(map(str, e.get('reasons') or []))}"
+                    if e.get("reasons") else ""))
+        cells.append(
+            f'<rect x="{i * w_cell}" y="2" width="{w_cell - 2}" '
+            f'height="{h - 4}" fill="{color}">'
+            f"<title>{html.escape(title)}</title></rect>")
+    w = len(sigs) * w_cell
+    legend = " ".join(
+        f'<span style="color:{c}">■</span> {a}'
+        for a, c in _ADVICE_COLOR.items())
+    return (f'<div class=row><svg width="{w}" height="{h}">'
+            + "".join(cells) + f"</svg><span class=meta> {legend}</span></div>")
+
+
+def _series_sparklines(series: Dict[str, List[Tuple[float, float]]]) -> str:
+    """One sparkline per stored series, NaN gaps preserved as breaks
+    (``_svg_spark`` drops non-finite points, leaving a visible hole)."""
+    out: List[str] = []
+    for key in sorted(series):
+        pts = series[key]
+        vals = [v for _, v in pts]
+        finite = [v for v in vals if not math.isnan(v)]
+        gaps = len(vals) - len(finite)
+        label = (f"{key} — {len(vals)} pts"
+                 + (f", {gaps} gaps" if gaps else "")
+                 + (f", last {finite[-1]:.4g}" if finite else ""))
+        out.append("<div class=row>" + _svg_spark(vals, label=label)
+                   + "</div>")
+    return "".join(out)
+
+
+def render_dash(events: Sequence[Dict[str, Any]],
+                series: Optional[Dict[str, List[Tuple[float, float]]]] = None,
+                *, title: str = "Fleet dashboard") -> str:
+    """One self-contained HTML page from a collector run's events (+ the
+    decoded ``fleet_series`` sidecar when available)."""
+    events = [e for e in events if isinstance(e, dict)]
+    start = next((e for e in events if e.get("event") == "run_start"), {})
+    sigs = [e for e in events if e.get("event") == "fleet_signals"]
+    snap = next((e for e in reversed(events)
+                 if e.get("event") == "fleet_series"), None)
+    body: List[str] = [
+        f"<h1>{html.escape(title)}</h1>",
+        f"<p class=meta>run <code>"
+        f"{html.escape(str(start.get('run_id', '?')))}</code> · "
+        f"{len(sigs)} signal evaluation(s) · generated by "
+        f"tools/fleet_dash.py (stdlib+numpy, all assets inline)</p>",
+    ]
+    if not sigs and snap is None:
+        body.append("<p class=meta>(no fleet_signals / fleet_series events "
+                    "— run the collector: tools/serve_loadgen.py "
+                    "--collector)</p>")
+    if sigs:
+        last = sigs[-1]
+        threshold = 1.0
+        body.append("<h2>Burn gauges</h2>")
+        body.append("<p class=meta>error-rate burn per trailing window "
+                    f"(window_scale {_fmt(last.get('window_scale'))}: fast "
+                    f"{_fmt(last.get('fast_window_s'))}s / slow "
+                    f"{_fmt(last.get('slow_window_s'))}s); the page-worthy "
+                    "alert needs BOTH windows past the tick.</p>")
+        body.append(_burn_gauge("fast window",
+                                last.get("burn_fast") or 0.0, threshold))
+        body.append(_burn_gauge("slow window",
+                                last.get("burn_slow") or 0.0, threshold))
+        body.append("<div class=row>" + _svg_spark(
+            [e.get("burn_fast") for e in sigs],
+            label=f"fast-burn history, alerts fired "
+                  f"{_fmt(last.get('burn_alerts'))}") + "</div>")
+        body.append("<h2>Scale advice</h2>")
+        body.append(_advice_timeline(sigs))
+        body.append(
+            f"<p class=meta>last advice: "
+            f"<b>{html.escape(str(last.get('scale_advice', '?')))}</b>"
+            + ("; reasons: " + "; ".join(
+                html.escape(str(r)) for r in last.get("reasons") or [])
+               if last.get("reasons") else "") + "</p>")
+        rows = [[k, _fmt(last.get(k))] for k in (
+            "error_rate_fast", "error_rate_slow", "queue_slope",
+            "inflight_slope", "saturation", "latency_p99_s",
+            "store_hit_rate", "replicas_up", "replicas_total",
+            "scrape_errors", "scrape_error_rate", "latency_anomaly",
+            "store_hit_anomaly") if last.get(k) is not None]
+        if rows:
+            body.append("<h2>Latest signals</h2>"
+                        + _table(rows, ["signal", "value"]))
+        tenants = last.get("tenants")
+        if isinstance(tenants, dict) and tenants:
+            trows = [[t, _fmt(v.get("submitted_rate")),
+                      _fmt(v.get("served_rate")), _fmt(v.get("shed_rate")),
+                      _fmt(v.get("device_seconds"))]
+                     for t, v in sorted(tenants.items())
+                     if isinstance(v, dict)]
+            body.append("<h2>Per-tenant demand</h2>"
+                        "<p class=meta>submitted/served/shed rates over "
+                        "the slow window; device-seconds estimated from "
+                        "the scraped dispatch p50.</p>"
+                        + _table(trows, ["tenant", "submit/s", "served/s",
+                                         "shed/s", "device_s"]))
+    if snap is not None:
+        body.append("<h2>Series</h2>")
+        body.append(
+            f"<p class=meta>tsdb snapshot: {_fmt(snap.get('series'))} "
+            f"series / {_fmt(snap.get('samples'))} samples, "
+            f"{_fmt(snap.get('gaps'))} gap(s), "
+            f"{_fmt(snap.get('dropped'))} dropped, span "
+            f"[{_fmt(snap.get('t_first'))}, {_fmt(snap.get('t_last'))}]s"
+            "</p>")
+        if series:
+            body.append(_series_sparklines(series))
+        elif snap.get("sidecar"):
+            body.append(f"<p class=meta>(sidecar "
+                        f"{html.escape(str(snap['sidecar']))} not found — "
+                        "sparklines omitted)</p>")
+    return ("<!doctype html><html><head><meta charset='utf-8'>"
+            f"<title>{html.escape(title)}</title><style>{_CSS}</style>"
+            "</head><body>" + "".join(b for b in body if b)
+            + "</body></html>")
+
+
+def _find_series(events: Sequence[Dict[str, Any]], ledger_path: str,
+                 ) -> Optional[Dict[str, List[Tuple[float, float]]]]:
+    snap = next((e for e in reversed(events)
+                 if isinstance(e, dict) and e.get("event") == "fleet_series"
+                 and e.get("sidecar")), None)
+    if snap is None:
+        return None
+    sc = str(snap["sidecar"])
+    for cand in (sc, os.path.join(os.path.dirname(os.path.abspath(
+            ledger_path)), os.path.basename(sc))):
+        if os.path.isfile(cand):
+            try:
+                return load_series_sidecar(cand)
+            except Exception:  # noqa: BLE001 — a torn sidecar skips sparklines
+                return None
+    return None
+
+
+def write_dash(ledger_path: str, out_path: Optional[str] = None,
+               *, title: str = "Fleet dashboard") -> str:
+    """Render the LAST run of a collector ledger into a self-contained
+    HTML file next to it."""
+    events = _last_run(read_ledger(ledger_path))
+    series = _find_series(events, ledger_path)
+    out_path = out_path or os.path.splitext(ledger_path)[0] + "_fleet.html"
+    text = render_dash(events, series, title=title)
+    with open(out_path, "w") as f:
+        f.write(text)
+    return out_path
+
+
+def main(argv: List[str]) -> int:
+    args = list(argv[1:])
+    out = None
+    title = "Fleet dashboard"
+    rest: List[str] = []
+    i = 0
+    while i < len(args):
+        if args[i] == "--out" and i + 1 < len(args):
+            out = args[i + 1]
+            i += 2
+        elif args[i] == "--title" and i + 1 < len(args):
+            title = args[i + 1]
+            i += 2
+        else:
+            rest.append(args[i])
+            i += 1
+    if len(rest) != 1:
+        print(__doc__.strip(), file=sys.stderr)
+        return 2
+    try:
+        path = write_dash(rest[0], out, title=title)
+    except OSError as e:
+        print(f"fleet_dash: cannot read {rest[0]}: {e}", file=sys.stderr)
+        return 2
+    print(path)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv))

@@ -379,6 +379,52 @@ def render(events: List[Dict]) -> str:
                     f"GiB ({peak / pred:.2f}× predicted)"
                 )
 
+    # fleet telemetry plane (ISSUE 17): fleet_signals evaluations from
+    # the collector's signal engine + the fleet_series tsdb snapshot
+    sig_evs = [e for e in events if e.get("event") == "fleet_signals"]
+    if sig_evs:
+        last = sig_evs[-1]
+        advice_seq = "".join(
+            {"grow": "G", "hold": ".", "shrink": "s"}.get(
+                str(e.get("scale_advice")), "?")
+            for e in sig_evs
+        )
+        out += ["", "fleet signals (obs/signals.py over the scraped tsdb):",
+                f"  {len(sig_evs)} evaluations, burn alerts "
+                f"{last.get('burn_alerts', 0)}, advice timeline [{advice_seq}]"
+                f" (G=grow .=hold s=shrink)",
+                f"  last: burn fast={_f(last.get('burn_fast')):.2f} "
+                f"slow={_f(last.get('burn_slow')):.2f}  "
+                f"saturation={_f(last.get('saturation')):.2f}  "
+                f"queue_slope={_f(last.get('queue_slope')):.4f}/s  "
+                f"replicas {last.get('replicas_up', '?')}/"
+                f"{last.get('replicas_total', '?')} up  "
+                f"scrape_errors={_f(last.get('scrape_errors')):.0f} "
+                f"(rate {_f(last.get('scrape_error_rate')):.3f})  "
+                f"advice={last.get('scale_advice', '?')}"]
+        for reason in last.get("reasons") or []:
+            out.append(f"    reason: {reason}")
+        tenants = last.get("tenants")
+        if isinstance(tenants, dict) and tenants:
+            rows = [[t,
+                     f"{_f(v.get('submitted_rate')):.3f}",
+                     f"{_f(v.get('served_rate')):.3f}",
+                     f"{_f(v.get('shed_rate')):.3f}",
+                     f"{_f(v.get('device_seconds')):.3f}"]
+                    for t, v in sorted(tenants.items())
+                    if isinstance(v, dict)]
+            out += ["", "  per-tenant demand (rates over the slow window):",
+                    _table(rows, ["tenant", "submit/s", "served/s",
+                                  "shed/s", "device_s"])]
+    for e in events:
+        if e.get("event") != "fleet_series":
+            continue
+        out += ["", f"fleet series ({e.get('label', '?')}): "
+                f"{e.get('series', '?')} series / {e.get('samples', '?')} "
+                f"samples, {e.get('gaps', 0)} gaps, {e.get('dropped', 0)} "
+                f"dropped, span [{e.get('t_first')}, {e.get('t_last')}]s "
+                f"(sidecar {e.get('sidecar', '-')})"]
+
     end = next((e for e in events if e.get("event") == "run_end"), None)
     if end is not None:
         out += ["", f"run ended at t={end.get('t')}s "

@@ -364,6 +364,41 @@ def render_diff(base: Dict, new: Dict, result: Dict) -> str:
                 _table(rows, ["objective", "target", "actual", "burn",
                               "verdict"])]
 
+    # fleet-signal section (fleet_signals/fleet_series events —
+    # obs/signals.py over the collector's tsdb, ISSUE 17): absent/empty
+    # for pre-PR-17 ledgers and collector-off runs, table omitted
+    sigs = sorted(set(base.get("signals") or {})
+                  | set(new.get("signals") or {}))
+    if sigs:
+        rows = []
+        for label in sigs:
+            b = (base.get("signals") or {}).get(label, {})
+            n = (new.get("signals") or {}).get(label, {})
+
+            def ncell(metric, b=b, n=n):
+                bv, nv = b.get(metric), n.get(metric)
+                if bv is None and nv is None:
+                    return "-"
+                if bv is None or nv is None:
+                    return f"{_fmt(bv)} → {_fmt(nv)}"
+                if bv == nv:
+                    return _fmt(nv)
+                return f"{_fmt(bv)} → {_fmt(nv)}"
+
+            advice = "-"
+            if n:
+                advice = next((a for a in ("grow", "hold", "shrink")
+                               if n.get(f"advice_{a}") == 1.0), "-")
+            rows.append([label, ncell("burn_fast"), ncell("burn_slow"),
+                         ncell("burn_alerts"), ncell("saturation"),
+                         ncell("scrape_error_rate"),
+                         ncell("replicas_up"), advice])
+        out += ["", "fleet signals (fleet_signals — any new burn alert "
+                "regresses; saturation/scrape errors by growing):",
+                _table(rows, ["label", "burn_fast", "burn_slow", "alerts",
+                              "saturation", "scrape_err_rate", "up",
+                              "advice"])]
+
     comp = sorted(set(base.get("compiles", {})) | set(new.get("compiles", {})))
     if comp:
         rows = []

@@ -44,6 +44,21 @@ engines' ``fault``/``breaker``/``serve_health`` events and the router's
 
     python tools/serve_loadgen.py --router 2 --tiny --requests 16 \
         --replica_faults 0:unavail@1-999 --min_success_rate 0.6
+
+Telemetry plane (ISSUE 17): ``--collector`` runs a
+``videop2p_tpu.serve.collector.FleetCollector`` scrape loop alongside
+the closed loop — every replica's + the router's ``/healthz`` and
+``/metrics`` polled every ``--scrape_interval_s`` into a bounded
+time-series store, with burn-rate/trend/demand signals evaluated on the
+same cadence (``--window_scale`` shrinks the 300s/3600s SLO windows so
+short smoke runs span them). The run's ``fleet_signals`` trail and the
+final ``fleet_series`` snapshot (+ ``.npz`` sidecar in ``--out_dir``)
+land in the SAME loadgen ledger: ``tools/obs_diff.py`` gates them via
+``SIGNAL_RULES`` and ``tools/fleet_dash.py`` renders the dashboard:
+
+    python tools/serve_loadgen.py --router 2 --tiny --requests 16 \
+        --collector --window_scale 0.02 --ledger fleet.jsonl
+    python tools/fleet_dash.py fleet.jsonl
 """
 
 from __future__ import annotations
@@ -452,6 +467,26 @@ def main(argv=None) -> int:
                     help="evaluate the default SLOs over this run's "
                          "summaries into slo_report ledger events "
                          "(obs_diff SLO_RULES gate the budget burn)")
+    ap.add_argument("--collector", action="store_true",
+                    help="fleet telemetry plane (ISSUE 17): run a "
+                         "FleetCollector scrape loop against the target "
+                         "(every replica + the router in --router mode) "
+                         "for the duration of the run; its fleet_signals "
+                         "evaluations and the fleet_series tsdb snapshot "
+                         "(+ .npz sidecar in --out_dir) land in THIS "
+                         "ledger — gate with obs_diff SIGNAL_RULES, "
+                         "render with tools/fleet_dash.py")
+    ap.add_argument("--scrape_interval_s", type=float, default=0.5,
+                    help="collector scrape/evaluate cadence")
+    ap.add_argument("--window_scale", type=float, default=1.0,
+                    help="scale the signal windows (fast 300s / slow "
+                         "3600s x this) — short smoke runs want ~0.01 so "
+                         "a 30s run spans the slow window")
+    ap.add_argument("--saturation_threshold", type=float, default=5.0,
+                    help="queue-wait-p99 / dispatch-p50 ratio past which "
+                         "the signals advise grow — tiny CPU smoke "
+                         "engines legitimately run 10-50x under a closed "
+                         "loop, so raise this (e.g. 100) when smoking")
     # in-process engine knobs (smoke + fleet modes)
     ap.add_argument("--tiny", action="store_true", default=None)
     ap.add_argument("--steps", type=int, default=4)
@@ -501,6 +536,9 @@ def main(argv=None) -> int:
     if args.replica_faults and not args.router:
         ap.error("--replica_faults needs --router N (per-replica fleet "
                  "chaos)")
+    if args.collector and args.inproc:
+        ap.error("--collector scrapes HTTP surfaces — use --router N or "
+                 "--url (an --inproc engine has no /metrics endpoint)")
 
     request = {
         "image_path": args.image,
@@ -512,7 +550,9 @@ def main(argv=None) -> int:
     engine = None
     supervisor = None
     router_server = None
+    collector = None
     collect_extra = None
+    scrape_targets: List[Any] = []
     chaos = bool(args.faults or args.replica_faults)
 
     def engine_kwargs():
@@ -533,6 +573,7 @@ def main(argv=None) -> int:
     if args.url:
         target = _HttpTarget(args.url, args.timeout_s)
         meta = {"target": args.url}
+        scrape_targets = [("engine", args.url)]
 
         def collect_extra(record, client=target.client):
             # client-side reliability summary (the remote engine's own
@@ -591,6 +632,8 @@ def main(argv=None) -> int:
                         ledger_path=router_ledger, tracing=args.tracing)
         router_server = RouterServer(router).start()
         target = _HttpTarget(router_server.url, args.timeout_s)
+        scrape_targets = ([(r.name, r.url) for r in supervisor.replicas]
+                          + [("router", router_server.url)])
         meta = {"target": f"router[{args.router}]", "tiny": tiny,
                 "steps": args.steps, "scheduler": args.scheduler,
                 "replica_faults": list(args.replica_faults)}
@@ -636,6 +679,46 @@ def main(argv=None) -> int:
                 {"event": "serve_health", **engine.health_record()}
             ]
 
+    if args.collector:
+        from videop2p_tpu.serve.collector import FleetCollector
+
+        collector = FleetCollector(
+            scrape_targets,
+            interval_s=args.scrape_interval_s,
+            window_scale=args.window_scale,
+            signal_kwargs=dict(
+                saturation_threshold=args.saturation_threshold),
+        )
+        collector.start()
+        meta["collector"] = {"targets": [n for n, _ in scrape_targets],
+                             "scrape_interval_s": args.scrape_interval_s,
+                             "window_scale": args.window_scale,
+                             "saturation_threshold":
+                                 args.saturation_threshold}
+        print(f"[loadgen] collector scraping {len(scrape_targets)} "
+              f"target(s) every {args.scrape_interval_s}s "
+              f"(window_scale {args.window_scale})")
+        base_collect = collect_extra
+
+        def collect_extra(record, base=base_collect, collector=collector):
+            # stop the scrape loop, drain its buffered fleet_signals
+            # evaluations + the fleet_series tsdb snapshot into THIS
+            # ledger (one file gates latency, reliability AND signals),
+            # and fold the signal roll-up into the summary record
+            events = list(base(record) or []) if base is not None else []
+            collector.stop(final_evaluate=True)
+            events += [{"event": "fleet_signals", **r}
+                       for r in collector.history]
+            os.makedirs(args.out_dir, exist_ok=True)
+            snap = collector.snapshot(
+                label="fleet",
+                sidecar_path=os.path.join(args.out_dir,
+                                          "fleet_series.npz"))
+            events.append({"event": "fleet_series", **snap})
+            record["signals"] = {**collector.signals.summary(),
+                                 **collector.stats()}
+            return events
+
     mutate_request = None
     if args.distinct_seeds:
         # closed-loop cold traffic: unique seed per request issue index
@@ -654,6 +737,8 @@ def main(argv=None) -> int:
             slo=args.slo,
         )
     finally:
+        if collector is not None:
+            collector.stop(final_evaluate=False)  # no-op when drained
         if router_server is not None:
             router_server.close()
         if supervisor is not None:

@@ -52,7 +52,20 @@ Pillars (ISSUEs 2–4):
     ``slo_report`` events with per-objective error-budget burn, gated by
     ``SLO_RULES`` in obs_diff.
   * :mod:`videop2p_tpu.obs.prom` — Prometheus text exposition of the
-    serving ``/metrics`` records (``?format=prometheus``).
+    serving ``/metrics`` records (``?format=prometheus``) and the
+    :func:`parse_prometheus` round-tripper the fleet collector scrapes
+    through.
+  * :mod:`videop2p_tpu.obs.tsdb` — bounded ring-buffer time-series
+    store (ISSUE 17): label-keyed series with caller-injected monotonic
+    timestamps, aligned trailing-window queries, explicit gap markers
+    and ``fleet_series`` snapshot events + ``.npz`` sidecars.
+  * :mod:`videop2p_tpu.obs.signals` — derived fleet signals over the
+    tsdb: multi-window multi-burn-rate SLO alerts, Theil–Sen trend
+    slopes, replica saturation, per-tenant demand metering and EWMA
+    anomaly flags, emitted as ``fleet_signals`` events with
+    ``scale_advice`` — gated by ``SIGNAL_RULES`` in obs_diff
+    (``serve/collector.py`` is the scrape loop, ``tools/fleet_dash.py``
+    the dashboard).
   * :mod:`videop2p_tpu.obs.comm` — distributed observability (ISSUE 5):
     collective-communication accounting of sharded programs
     (``comm_analysis`` events with per-kind counts/bytes + sharding
@@ -90,6 +103,7 @@ from videop2p_tpu.obs.history import (
     FAULT_RULES,
     QUALITY_RULES,
     SEGMENT_RULES,
+    SIGNAL_RULES,
     SLO_RULES,
     TIMING_RULES,
     RegressionRule,
@@ -130,8 +144,19 @@ from videop2p_tpu.obs.telemetry import (
 )
 from videop2p_tpu.obs.prom import (
     engine_metrics_prometheus,
+    parse_prometheus,
     render_prometheus,
     router_metrics_prometheus,
+)
+from videop2p_tpu.obs.signals import (
+    FLEET_SIGNALS_FIELDS,
+    SignalEngine,
+    theil_sen_slope,
+)
+from videop2p_tpu.obs.tsdb import (
+    FLEET_SERIES_FIELDS,
+    TimeSeriesStore,
+    load_series_sidecar,
 )
 from videop2p_tpu.obs.slo import (
     DEFAULT_SLOS,
@@ -215,8 +240,16 @@ __all__ = [
     "emit_slo_reports",
     "record_from_summaries",
     "render_prometheus",
+    "parse_prometheus",
     "engine_metrics_prometheus",
     "router_metrics_prometheus",
+    "SIGNAL_RULES",
+    "FLEET_SERIES_FIELDS",
+    "TimeSeriesStore",
+    "load_series_sidecar",
+    "FLEET_SIGNALS_FIELDS",
+    "SignalEngine",
+    "theil_sen_slope",
     "EXECUTE_TIMING_FIELDS",
     "LatencyReservoir",
     "latency_enabled",

@@ -218,6 +218,25 @@ SEGMENT_RULES: Tuple[RegressionRule, ...] = (
                    min_abs=0.002),
 )
 
+# fleet-signal gates (ISSUE 17): the telemetry plane's `fleet_signals`
+# evaluations (obs/signals.py over the scraped tsdb). burn_alerts is the
+# cumulative both-windows-burning count — ANY new alert regresses
+# (threshold 0 + the 0.5 floor, the any-new-incident pattern), while a
+# zero-alert self-compare stays clean. scrape_error_rate climbing means
+# the telemetry plane itself degraded (dead replicas, wedged probes);
+# saturation is the queue-wait-p99 over dispatch-p50 ratio — noisy by
+# nature, so it gets the widest percentage band plus a 0.5 floor. The
+# per-tenant demand meters are schema-gated by test pins, not rules: a
+# demand SHIFT between runs is workload, not regression.
+SIGNAL_RULES: Tuple[RegressionRule, ...] = (
+    RegressionRule("burn_alerts", kind="signal", threshold_pct=0.0,
+                   min_abs=0.5),
+    RegressionRule("scrape_error_rate", kind="signal", threshold_pct=10.0,
+                   min_abs=0.01),
+    RegressionRule("saturation", kind="signal", threshold_pct=20.0,
+                   min_abs=0.5),
+)
+
 DEFAULT_RULES: Tuple[RegressionRule, ...] = (
     RegressionRule("flops", threshold_pct=10.0),
     RegressionRule("bytes_accessed", threshold_pct=15.0, min_abs=1 << 20),
@@ -227,7 +246,7 @@ DEFAULT_RULES: Tuple[RegressionRule, ...] = (
     RegressionRule("seconds", kind="compile", threshold_pct=50.0, min_abs=1.0),
     RegressionRule("seconds", kind="phase", threshold_pct=25.0, min_abs=0.5),
 ) + (QUALITY_RULES + COMM_RULES + TIMING_RULES + FAULT_RULES + SEAM_RULES
-     + SLO_RULES + SEGMENT_RULES)
+     + SLO_RULES + SEGMENT_RULES + SIGNAL_RULES)
 
 
 def split_runs(events: Iterable[Dict[str, Any]]) -> List[List[Dict[str, Any]]]:
@@ -284,6 +303,11 @@ def extract_run(events: Sequence[Dict[str, Any]],
         # percentiles from span events, per-objective SLO reports
         "segments": {},
         "slo": {},
+        # fleet-telemetry section (ISSUE 17) — likewise empty pre-PR-17
+        # or with the collector off: the last fleet_signals evaluation
+        # per label (plus per-tenant demand lanes and the fleet_series
+        # store summary), gated by SIGNAL_RULES
+        "signals": {},
     }
     seg_samples: Dict[str, List[float]] = {}
     for e in events:
@@ -438,6 +462,47 @@ def extract_run(events: Sequence[Dict[str, Any]],
                     )
                 except (TypeError, ValueError):
                     pass
+        elif kind == "fleet_signals":
+            # the telemetry plane's periodic evaluation (ISSUE 17): the
+            # LAST evaluation per label supersedes (cumulative counters
+            # like burn_alerts make it the run's roll-up). Bools land as
+            # 1.0/0.0; scale_advice becomes one-hots so a flip is a
+            # visible numeric delta; tenant demand lanes flatten like
+            # serve_health's tenants.
+            label = e.get("label") or "fleet"
+            vals = {}
+            for k, v in e.items():
+                if k in ("event", "t", "label", "tenants", "reasons",
+                         "scale_advice"):
+                    continue
+                if isinstance(v, bool):
+                    vals[k] = 1.0 if v else 0.0
+                elif isinstance(v, (int, float)):
+                    vals[k] = float(v)
+            advice = e.get("scale_advice")
+            if isinstance(advice, str):
+                for a in ("grow", "hold", "shrink"):
+                    vals[f"advice_{a}"] = 1.0 if advice == a else 0.0
+            rec["signals"][label] = vals
+            tenants = e.get("tenants")
+            if isinstance(tenants, dict):
+                for tname, tvals in tenants.items():
+                    if not isinstance(tvals, dict):
+                        continue
+                    rec["signals"][f"{label}:tenant:{tname}"] = {
+                        k: float(v) for k, v in tvals.items()
+                        if isinstance(v, (int, float))
+                        and not isinstance(v, bool)
+                    }
+        elif kind == "fleet_series":
+            # the tsdb snapshot summary joins the signals section under
+            # a ":series" sub-label (store health: gaps/drops/extent)
+            label = e.get("label") or "fleet"
+            rec["signals"][f"{label}:series"] = {
+                k: float(v) for k, v in e.items()
+                if k not in ("event", "t", "label", "sidecar")
+                and isinstance(v, (int, float)) and not isinstance(v, bool)
+            }
         elif kind == "slo_report":
             # one objective per event (obs/slo.py); a later evaluation in
             # the same run supersedes. `compliant` lands as 1.0/0.0 so the
@@ -494,8 +559,9 @@ def _rule_values(record: Dict[str, Any], rule: RegressionRule) -> Dict[str, floa
     elif rule.kind == "divergence":
         out = {k: float(v) for k, v in record.get("divergence", {}).items()}
     elif rule.kind in ("timing", "trace", "reliability", "stream", "slo",
-                       "segment"):
-        section = "segments" if rule.kind == "segment" else rule.kind
+                       "segment", "signal"):
+        section = {"segment": "segments", "signal": "signals"}.get(
+            rule.kind, rule.kind)
         for label, m in record.get(section, {}).items():
             if rule.metric in m:
                 out[label] = float(m[rule.metric])

@@ -21,7 +21,14 @@ test can pin it byte-for-byte):
   * bools render as 1/0, non-finite floats as ``+Inf``/``-Inf``/``NaN``
     (all legal in the exposition format), strings and None are skipped
     (identity fields like fingerprints have no gauge meaning);
-  * every metric gets one ``# TYPE <name> gauge`` comment line.
+  * every metric gets one ``# HELP`` and one ``# TYPE <name> gauge``
+    comment line (exposition-format conformance, ISSUE 17).
+
+:func:`parse_prometheus` is the round-tripper: it reads exposition text
+(this module's or any conforming exporter's) back into samples, so the
+fleet collector (``serve/collector.py``) can scrape
+``/metrics?format=prometheus`` and land the identical scalars the JSON
+endpoint serves — the round-trip test pins that equivalence.
 
 Stdlib only; the import-guard test walks this module.
 """
@@ -34,6 +41,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 __all__ = [
     "render_prometheus",
+    "parse_prometheus",
     "engine_metrics_prometheus",
     "router_metrics_prometheus",
 ]
@@ -90,6 +98,7 @@ class _Sink:
     def render(self) -> str:
         lines: List[str] = []
         for name in sorted(self._series):
+            lines.append(f"# HELP {name} videop2p /metrics gauge.")
             lines.append(f"# TYPE {name} gauge")
             for label_str, text in sorted(self._series[name]):
                 lines.append(f"{name}{label_str} {text}")
@@ -169,3 +178,114 @@ def engine_metrics_prometheus(metrics: Dict[str, Any]) -> str:
 def router_metrics_prometheus(metrics: Dict[str, Any]) -> str:
     """Exposition text for the router's fleet ``metrics()`` record."""
     return render_prometheus(metrics)
+
+
+# ---- parsing (the round-trip half, ISSUE 17) ----------------------------
+
+def _parse_value(text: str) -> float:
+    if text == "NaN":
+        return float("nan")
+    if text == "+Inf":
+        return float("inf")
+    if text == "-Inf":
+        return float("-inf")
+    return float(text)
+
+
+def _parse_labels(text: str) -> Dict[str, str]:
+    """``k="v",k2="v2"`` (the braces already stripped) with exposition
+    escapes (``\\\\``, ``\\"``, ``\\n``) undone."""
+    labels: Dict[str, str] = {}
+    i, n = 0, len(text)
+    while i < n:
+        eq = text.index("=", i)
+        key = text[i:eq].strip().lstrip(",").strip()
+        i = eq + 1
+        if i >= n or text[i] != '"':
+            raise ValueError(f"malformed label value at {text[i:]!r}")
+        i += 1
+        out: List[str] = []
+        while i < n:
+            c = text[i]
+            if c == "\\" and i + 1 < n:
+                nxt = text[i + 1]
+                out.append({"n": "\n", '"': '"', "\\": "\\"}.get(nxt, nxt))
+                i += 2
+                continue
+            if c == '"':
+                i += 1
+                break
+            out.append(c)
+            i += 1
+        labels[key] = "".join(out)
+        while i < n and text[i] in ", ":
+            i += 1
+    return labels
+
+
+def parse_prometheus(text: str) -> Dict[str, Any]:
+    """Exposition text → ``{"samples": [...], "types": {...}, "help":
+    {...}}``.
+
+    Each sample is ``{"name", "labels", "value"}``. Malformed lines raise
+    (a scrape that half-parses would silently drop gauges); ``# TYPE`` /
+    ``# HELP`` comments are collected, other comments and blank lines are
+    skipped per the format.
+    """
+    samples: List[Dict[str, Any]] = []
+    types: Dict[str, str] = {}
+    help_text: Dict[str, str] = {}
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 3 and parts[1] == "TYPE":
+                types[parts[2]] = parts[3] if len(parts) > 3 else ""
+            elif len(parts) >= 3 and parts[1] == "HELP":
+                help_text[parts[2]] = parts[3] if len(parts) > 3 else ""
+            continue
+        if "{" in line:
+            name, _, rest = line.partition("{")
+            # the label block may contain '}' inside quoted values — scan
+            # for the closing brace outside quotes
+            depth_q = False
+            close = -1
+            i = 0
+            while i < len(rest):
+                c = rest[i]
+                if c == "\\" and depth_q:
+                    i += 2
+                    continue
+                if c == '"':
+                    depth_q = not depth_q
+                elif c == "}" and not depth_q:
+                    close = i
+                    break
+                i += 1
+            if close < 0:
+                raise ValueError(f"unterminated label block: {raw!r}")
+            labels = _parse_labels(rest[:close])
+            value_text = rest[close + 1:].strip().split()[0]
+        else:
+            fields = line.split()
+            if len(fields) < 2:
+                raise ValueError(f"malformed sample line: {raw!r}")
+            name, value_text = fields[0], fields[1]
+            labels = {}
+        samples.append({
+            "name": name.strip(),
+            "labels": labels,
+            "value": _parse_value(value_text),
+        })
+    return {"samples": samples, "types": types, "help": help_text}
+
+
+def samples_by_name(parsed: Dict[str, Any],
+                    ) -> Dict[str, List[Dict[str, Any]]]:
+    """Convenience index: ``{metric name: [sample, ...]}``."""
+    out: Dict[str, List[Dict[str, Any]]] = {}
+    for s in parsed.get("samples", ()):
+        out.setdefault(s["name"], []).append(s)
+    return out

@@ -384,6 +384,61 @@ def _trace_slo_section(events) -> str:
     return out
 
 
+def _fleet_section(events) -> str:
+    """Fleet telemetry plane (ISSUE 17): the collector's fleet_signals
+    evaluations — burn-rate history, advice timeline, the last
+    evaluation's headline numbers and per-tenant demand. Empty for
+    collector-off ledgers."""
+    sigs = [e for e in events if e.get("event") == "fleet_signals"]
+    if not sigs:
+        return ""
+    last = sigs[-1]
+    out = ("<h2>Fleet signals</h2>"
+           "<p class=meta>obs/signals.py over the scraped tsdb "
+           "(serve/collector.py) — multi-window burn rates, trend slopes, "
+           "saturation and demand metering (gated by SIGNAL_RULES; full "
+           "dashboard via tools/fleet_dash.py).</p>")
+    fast = [e.get("burn_fast") for e in sigs]
+    slow = [e.get("burn_slow") for e in sigs]
+    out += ("<div class=row>" + _svg_spark(fast, label=(
+            f"burn (fast window) over {len(sigs)} evaluations, last "
+            f"{_fmt(last.get('burn_fast'))}")) + "</div>")
+    out += ("<div class=row>" + _svg_spark(slow, label=(
+            f"burn (slow window), last {_fmt(last.get('burn_slow'))}"))
+            + "</div>")
+    advice_seq = "".join(
+        {"grow": "G", "hold": "·", "shrink": "s"}.get(
+            str(e.get("scale_advice")), "?") for e in sigs)
+    out += (f"<p class=meta>advice timeline <code>{html.escape(advice_seq)}"
+            f"</code> (G=grow ·=hold s=shrink) — last: "
+            f"<b>{html.escape(str(last.get('scale_advice', '?')))}</b>, "
+            f"burn alerts {_fmt(last.get('burn_alerts'))}, replicas "
+            f"{_fmt(last.get('replicas_up'))}/"
+            f"{_fmt(last.get('replicas_total'))} up, scrape errors "
+            f"{_fmt(last.get('scrape_errors'))}</p>")
+    reasons = last.get("reasons") or []
+    if reasons:
+        out += ("<p class=meta>reasons: "
+                + "; ".join(html.escape(str(r)) for r in reasons) + "</p>")
+    rows = [[k, _fmt(last.get(k))] for k in (
+        "error_rate_fast", "error_rate_slow", "queue_slope",
+        "inflight_slope", "saturation", "latency_p99_s", "store_hit_rate",
+        "scrape_error_rate") if last.get(k) is not None]
+    if rows:
+        out += _table(rows, ["signal", "value"])
+    tenants = last.get("tenants")
+    if isinstance(tenants, dict) and tenants:
+        trows = [[t, _fmt(v.get("submitted_rate")),
+                  _fmt(v.get("served_rate")), _fmt(v.get("shed_rate")),
+                  _fmt(v.get("device_seconds"))]
+                 for t, v in sorted(tenants.items()) if isinstance(v, dict)]
+        out += ("<p class=meta>per-tenant demand (rates over the slow "
+                "window):</p>"
+                + _table(trows, ["tenant", "submit/s", "served/s",
+                                 "shed/s", "device_s"]))
+    return out
+
+
 def _null_text_section(events) -> str:
     ev = next((e for e in events if e.get("event") == "telemetry"
                and e.get("loss_curve")), None)
@@ -618,6 +673,7 @@ def render_report(events: Sequence[Dict[str, Any]],
         _null_text_section(events),
         _stream_section(events),
         _trace_slo_section(events),
+        _fleet_section(events),
         _comm_section(events),
         _time_section(events),
         _verdict_section(events),

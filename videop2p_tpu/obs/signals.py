@@ -1,0 +1,440 @@
+"""Derived fleet signals over the scraped time-series store (ISSUE 17).
+
+``serve/collector.py`` lands raw gauges/counters in a
+:class:`~videop2p_tpu.obs.tsdb.TimeSeriesStore`; this module turns the
+trailing buffers into the signals an autoscaler (PR 18) or an on-call
+human actually acts on:
+
+  * **multi-window multi-burn-rate SLO alerts** — the SRE page/ticket
+    split: the availability error-rate is measured over a FAST
+    (5-minute-equivalent) and a SLOW (1-hour-equivalent) trailing
+    window, each divided by the SLO target into a burn rate, and the
+    alert fires only when BOTH windows burn above threshold. The fast
+    window alone is noisy (one bad scrape pages nobody), the slow window
+    alone is sluggish (an outage takes an hour to page); requiring both
+    gives fast detection that auto-resolves when the error stops. A
+    ``window_scale`` knob shrinks both windows proportionally so tests
+    (and CPU loadgen runs) exercise the real code path in seconds.
+  * **trend slopes** — robust Theil–Sen (median of pairwise slopes, so
+    one outlier scrape cannot fake a trend) over queue depth and
+    in-flight, summed across replicas: the fleet's backlog growth rate.
+  * **replica saturation** — the worst replica's queue-wait p99 over its
+    dispatch p50: "how many dispatches deep is the queue" in time units;
+    the classic rho > 1 saturation smell scaled to observed service time.
+  * **per-tenant demand metering** — submitted/served/shed rates per
+    tenant lane over the slow window plus estimated device-seconds
+    (served increase x the fleet dispatch p50 — reservoir summaries are
+    the only per-request duration surface the scrape exposes).
+  * **EWMA anomaly flags** — exponentially-weighted mean/variance per
+    watched headline (latency p99 up, store hit-rate down); a flag is a
+    deviation beyond ``tolerance`` sigmas with an absolute floor.
+
+Every evaluation emits one ``fleet_signals`` ledger event
+(``FLEET_SIGNALS_FIELDS``) with machine-readable ``scale_advice`` in
+{grow, hold, shrink} + human-readable ``reasons`` — obs/history.py's
+``SIGNAL_RULES`` gate these records across runs like every other layer.
+
+Stdlib+numpy only — the import-guard test walks this module.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from videop2p_tpu.obs.tsdb import TimeSeriesStore
+
+__all__ = [
+    "FLEET_SIGNALS_FIELDS",
+    "SignalEngine",
+    "theil_sen_slope",
+    "S_UP",
+    "S_QUEUE_DEPTH",
+    "S_IN_FLIGHT",
+    "S_REQUESTS",
+    "S_LATENCY_P50",
+    "S_LATENCY_P99",
+    "S_QUEUE_WAIT_P99",
+    "S_DISPATCH_P50",
+    "S_STORE_HIT_RATE",
+    "S_SCRAPES",
+    "S_SCRAPE_ERRORS",
+    "S_TENANT",
+]
+
+# ---- the series-name contract between collector and signals --------------
+# (the collector writes these; the signal engine reads them — one place)
+
+S_UP = "up"                         # 1/0 liveness, labels {replica}
+S_QUEUE_DEPTH = "queue_depth"       # gauge, labels {replica}
+S_IN_FLIGHT = "in_flight"           # gauge, labels {replica}
+S_REQUESTS = "requests_total"       # cumulative, labels {replica, status}
+S_LATENCY_P50 = "latency_p50_s"     # e2e blocked p50, labels {replica}
+S_LATENCY_P99 = "latency_p99_s"     # e2e blocked p99, labels {replica}
+S_QUEUE_WAIT_P99 = "queue_wait_p99_s"   # labels {replica}
+S_DISPATCH_P50 = "dispatch_p50_s"       # labels {replica}
+S_STORE_HIT_RATE = "store_hit_rate"     # labels {replica}
+S_SCRAPES = "scrapes_total"             # cumulative, labels {replica}
+S_SCRAPE_ERRORS = "scrape_errors_total"  # cumulative, labels {replica}
+S_TENANT = "tenant_total"   # cumulative, labels {replica, tenant, field}
+
+# request statuses that mean "the engine failed the request" vs finished
+ERROR_STATUSES = ("error", "deadline_exceeded")
+FINISHED_STATUSES = ("done", "error", "deadline_exceeded", "engine_closed")
+
+# the `fleet_signals` ledger event schema (pinned by test_bench_guard)
+FLEET_SIGNALS_FIELDS = (
+    "label",
+    "t",
+    "window_scale",
+    "fast_window_s",
+    "slow_window_s",
+    "error_rate_fast",
+    "error_rate_slow",
+    "burn_fast",
+    "burn_slow",
+    "burn_alert",
+    "burn_alerts",
+    "queue_slope",
+    "inflight_slope",
+    "saturation",
+    "latency_p99_s",
+    "store_hit_rate",
+    "latency_anomaly",
+    "store_hit_anomaly",
+    "scrape_errors",
+    "scrape_error_rate",
+    "replicas_up",
+    "replicas_total",
+    "tenants",
+    "scale_advice",
+    "reasons",
+)
+
+# per-tenant demand sub-record schema (the "demand metering" columns)
+FLEET_TENANT_FIELDS = (
+    "submitted_rate", "served_rate", "shed_rate", "device_seconds",
+)
+
+
+def theil_sen_slope(points: Sequence[Tuple[float, float]],
+                    max_points: int = 100) -> float:
+    """Median of pairwise slopes — the robust trend estimator (up to 29%
+    arbitrary outliers cannot move it). 0.0 with < 2 usable points."""
+    pts = list(points)[-max_points:]
+    if len(pts) < 2:
+        return 0.0
+    ts = np.asarray([t for t, _ in pts], np.float64)
+    vs = np.asarray([v for _, v in pts], np.float64)
+    dt = np.subtract.outer(ts, ts)
+    dv = np.subtract.outer(vs, vs)
+    mask = dt > 0
+    if not mask.any():
+        return 0.0
+    return float(np.median(dv[mask] / dt[mask]))
+
+
+class _Ewma:
+    """Exponentially-weighted mean + variance with a deviation flag."""
+
+    def __init__(self, alpha: float, tolerance: float, floor: float):
+        self.alpha = float(alpha)
+        self.tolerance = float(tolerance)
+        self.floor = float(floor)
+        self.mean: Optional[float] = None
+        self.var = 0.0
+        self.count = 0
+
+    def observe(self, x: float, direction: str = "increase") -> bool:
+        """Flag-then-update: is ``x`` anomalous vs the state BEFORE it?"""
+        anomalous = False
+        if self.mean is not None and self.count >= 3:
+            dev = x - self.mean
+            band = self.tolerance * math.sqrt(self.var) + self.floor
+            if direction == "increase":
+                anomalous = dev > band
+            else:
+                anomalous = -dev > band
+        if self.mean is None:
+            self.mean = float(x)
+        else:
+            delta = float(x) - self.mean
+            self.mean += self.alpha * delta
+            self.var = (1.0 - self.alpha) * (self.var + self.alpha * delta * delta)
+        self.count += 1
+        return anomalous
+
+
+class SignalEngine:
+    """Stateful evaluator: call :meth:`evaluate` on a cadence; each call
+    reads the trailing windows out of the tsdb and emits one
+    ``fleet_signals`` event. EWMA baselines and the cumulative burn-alert
+    count live here (the tsdb stays a dumb buffer)."""
+
+    def __init__(
+        self,
+        tsdb: TimeSeriesStore,
+        *,
+        label: str = "fleet",
+        window_scale: float = 1.0,
+        slo_error_rate: float = 0.01,
+        burn_threshold: float = 1.0,
+        saturation_threshold: float = 5.0,
+        queue_slope_threshold: float = 0.05,
+        ewma_alpha: float = 0.3,
+        ewma_tolerance: float = 3.0,
+        router_name: str = "router",
+    ):
+        self.tsdb = tsdb
+        self.label = str(label)
+        self.window_scale = float(window_scale)
+        self.fast_window_s = 300.0 * self.window_scale
+        self.slow_window_s = 3600.0 * self.window_scale
+        self.slo_error_rate = float(slo_error_rate)
+        self.burn_threshold = float(burn_threshold)
+        self.saturation_threshold = float(saturation_threshold)
+        self.queue_slope_threshold = float(queue_slope_threshold)
+        self.router_name = str(router_name)
+        self.burn_alerts = 0
+        self.evaluations = 0
+        self.advice_counts: Dict[str, int] = {"grow": 0, "hold": 0,
+                                              "shrink": 0}
+        self._lat_ewma = _Ewma(ewma_alpha, ewma_tolerance, floor=0.005)
+        self._hit_ewma = _Ewma(ewma_alpha, ewma_tolerance, floor=0.05)
+
+    # ---- pieces ----------------------------------------------------------
+
+    def _replica_labels(self) -> List[Dict[str, str]]:
+        return [ls for ls in self.tsdb.labelsets(S_UP)
+                if ls.get("replica") != self.router_name]
+
+    def _error_rate(self, now: float, window_s: float) -> Optional[float]:
+        """Fleet error fraction over one window: failed finishes over all
+        finishes, summed across replicas (router excluded — its per-status
+        counts are the replicas' re-aggregated)."""
+        errors = 0.0
+        finished = 0.0
+        seen = False
+        for ls in self.tsdb.labelsets(S_REQUESTS):
+            if ls.get("replica") == self.router_name:
+                continue
+            status = ls.get("status")
+            if status not in FINISHED_STATUSES:
+                continue
+            inc = self.tsdb.increase(S_REQUESTS, now, window_s, ls)
+            if inc is None:
+                continue
+            seen = True
+            finished += inc
+            if status in ERROR_STATUSES:
+                errors += inc
+        if not seen:
+            return None
+        if finished <= 0:
+            return 0.0
+        return errors / finished
+
+    def _fleet_slope(self, name: str, now: float, window_s: float) -> float:
+        return sum(
+            theil_sen_slope(self.tsdb.window(name, now, window_s, ls))
+            for ls in self.tsdb.labelsets(name)
+            if ls.get("replica") != self.router_name
+        )
+
+    def _saturation(self, now: float) -> float:
+        """max over replicas of queue-wait p99 / dispatch p50 (both from
+        the scraped reservoir summaries; 0.0 until both exist)."""
+        worst = 0.0
+        for ls in self._replica_labels():
+            rl = {"replica": ls.get("replica")}
+            qw = self.tsdb.latest(S_QUEUE_WAIT_P99, rl)
+            dp = self.tsdb.latest(S_DISPATCH_P50, rl)
+            if qw is None or dp is None or dp[1] <= 0.0:
+                continue
+            worst = max(worst, qw[1] / dp[1])
+        return worst
+
+    def _tenant_demand(self, now: float,
+                       dispatch_p50: Optional[float]) -> Dict[str, Any]:
+        """Per-lane submitted/served/shed rates over the slow window plus
+        estimated device-seconds (served increase x dispatch p50)."""
+        lanes: Dict[str, Dict[str, float]] = {}
+        sums: Dict[str, Dict[str, float]] = {}
+        for ls in self.tsdb.labelsets(S_TENANT):
+            tenant = ls.get("tenant")
+            fld = ls.get("field")
+            if tenant is None or fld is None:
+                continue
+            inc = self.tsdb.increase(S_TENANT, now, self.slow_window_s, ls)
+            rate = self.tsdb.rate(S_TENANT, now, self.slow_window_s, ls)
+            if inc is None or rate is None:
+                continue
+            acc = sums.setdefault(tenant, {})
+            acc[f"{fld}_inc"] = acc.get(f"{fld}_inc", 0.0) + inc
+            acc[f"{fld}_rate"] = acc.get(f"{fld}_rate", 0.0) + rate
+        for tenant, acc in sorted(sums.items()):
+            served_inc = acc.get("done_inc", 0.0)
+            lanes[tenant] = {
+                "submitted_rate": round(acc.get("submitted_rate", 0.0), 6),
+                "served_rate": round(acc.get("done_rate", 0.0), 6),
+                "shed_rate": round(acc.get("shed_rate", 0.0)
+                                   + acc.get("rejected_rate", 0.0), 6),
+                "device_seconds": round(
+                    served_inc * (dispatch_p50 or 0.0), 6),
+            }
+        return lanes
+
+    def _scrape_stats(self, now: float) -> Tuple[float, float]:
+        scrapes = errors = 0.0
+        for ls in self.tsdb.labelsets(S_SCRAPES):
+            latest = self.tsdb.latest(S_SCRAPES, ls)
+            if latest is not None:
+                scrapes += latest[1]
+        for ls in self.tsdb.labelsets(S_SCRAPE_ERRORS):
+            latest = self.tsdb.latest(S_SCRAPE_ERRORS, ls)
+            if latest is not None:
+                errors += latest[1]
+        rate = errors / scrapes if scrapes > 0 else 0.0
+        return errors, rate
+
+    # ---- the evaluation --------------------------------------------------
+
+    def evaluate(self, now: float, ledger: Any = None) -> Dict[str, Any]:
+        """One signal pass at time ``now`` → the ``fleet_signals`` record
+        (emitted into ``ledger`` when given)."""
+        t = float(now)
+        er_fast = self._error_rate(t, self.fast_window_s)
+        er_slow = self._error_rate(t, self.slow_window_s)
+        burn_fast = ((er_fast / self.slo_error_rate)
+                     if er_fast is not None and self.slo_error_rate > 0
+                     else 0.0)
+        burn_slow = ((er_slow / self.slo_error_rate)
+                     if er_slow is not None and self.slo_error_rate > 0
+                     else 0.0)
+        burn_alert = (burn_fast > self.burn_threshold
+                      and burn_slow > self.burn_threshold)
+        if burn_alert:
+            self.burn_alerts += 1
+
+        queue_slope = self._fleet_slope(S_QUEUE_DEPTH, t, self.slow_window_s)
+        inflight_slope = self._fleet_slope(S_IN_FLIGHT, t, self.slow_window_s)
+        saturation = self._saturation(t)
+
+        # fleet headline gauges: worst replica latency p99, mean hit rate
+        lat_vals = [self.tsdb.latest(S_LATENCY_P99, ls)
+                    for ls in self._replica_labels()]
+        lat_vals = [v[1] for v in lat_vals if v is not None]
+        latency_p99 = max(lat_vals) if lat_vals else None
+        hit_vals = [self.tsdb.latest(S_STORE_HIT_RATE, ls)
+                    for ls in self._replica_labels()]
+        hit_vals = [v[1] for v in hit_vals if v is not None]
+        hit_rate = (sum(hit_vals) / len(hit_vals)) if hit_vals else None
+        latency_anomaly = (self._lat_ewma.observe(latency_p99, "increase")
+                           if latency_p99 is not None else False)
+        store_hit_anomaly = (self._hit_ewma.observe(hit_rate, "decrease")
+                             if hit_rate is not None else False)
+
+        replica_ls = self._replica_labels()
+        replicas_total = len(replica_ls)
+        replicas_up = 0
+        for ls in replica_ls:
+            latest = self.tsdb.latest(S_UP, ls)
+            # a latest of None means every sample was a gap — down
+            if latest is not None and latest[1] >= 1.0:
+                # gaps AFTER the last finite sample also mean down NOW
+                ring = self.tsdb.series(S_UP, ls)
+                if ring and not math.isnan(ring[-1][1]) and ring[-1][1] >= 1.0:
+                    replicas_up += 1
+        scrape_errors, scrape_error_rate = self._scrape_stats(t)
+
+        dp_vals = [self.tsdb.latest(S_DISPATCH_P50, ls)
+                   for ls in self._replica_labels()]
+        dp_vals = [v[1] for v in dp_vals if v is not None]
+        dispatch_p50 = (sum(dp_vals) / len(dp_vals)) if dp_vals else None
+        tenants = self._tenant_demand(t, dispatch_p50)
+
+        # ---- scale advice ------------------------------------------------
+        reasons: List[str] = []
+        if burn_alert:
+            reasons.append(
+                f"slo-burn fast={burn_fast:.2f} slow={burn_slow:.2f} "
+                f"(threshold {self.burn_threshold:g})")
+        if saturation > self.saturation_threshold:
+            reasons.append(f"saturation {saturation:.2f} > "
+                           f"{self.saturation_threshold:g}")
+        if queue_slope > self.queue_slope_threshold:
+            qmeans = [self.tsdb.mean(S_QUEUE_DEPTH, t, self.slow_window_s, ls)
+                      for ls in self.tsdb.labelsets(S_QUEUE_DEPTH)]
+            if any((q or 0.0) > 0.0 for q in qmeans):
+                reasons.append(f"queue growing {queue_slope:.3f}/s")
+        if replicas_total and replicas_up < replicas_total:
+            reasons.append(
+                f"replicas down {replicas_total - replicas_up}/"
+                f"{replicas_total}")
+        if reasons:
+            advice = "grow"
+        else:
+            idle = bool(replica_ls)
+            for ls in replica_ls:
+                rl = {"replica": ls.get("replica")}
+                q = self.tsdb.window(S_QUEUE_DEPTH, t, self.slow_window_s, rl)
+                f = self.tsdb.window(S_IN_FLIGHT, t, self.slow_window_s, rl)
+                if len(q) < 2 or len(f) < 2:
+                    idle = False
+                    break
+                if max(v for _, v in q) > 0 or max(v for _, v in f) > 0:
+                    idle = False
+                    break
+            if idle:
+                advice = "shrink"
+                reasons.append("fleet idle over the slow window")
+            else:
+                advice = "hold"
+        self.evaluations += 1
+        self.advice_counts[advice] = self.advice_counts.get(advice, 0) + 1
+
+        rec: Dict[str, Any] = {
+            "label": self.label,
+            "t": round(t, 6),
+            "window_scale": self.window_scale,
+            "fast_window_s": round(self.fast_window_s, 6),
+            "slow_window_s": round(self.slow_window_s, 6),
+            "error_rate_fast": (round(er_fast, 6)
+                                if er_fast is not None else None),
+            "error_rate_slow": (round(er_slow, 6)
+                                if er_slow is not None else None),
+            "burn_fast": round(burn_fast, 4),
+            "burn_slow": round(burn_slow, 4),
+            "burn_alert": burn_alert,
+            "burn_alerts": self.burn_alerts,
+            "queue_slope": round(queue_slope, 6),
+            "inflight_slope": round(inflight_slope, 6),
+            "saturation": round(saturation, 4),
+            "latency_p99_s": (round(latency_p99, 6)
+                              if latency_p99 is not None else None),
+            "store_hit_rate": (round(hit_rate, 4)
+                               if hit_rate is not None else None),
+            "latency_anomaly": latency_anomaly,
+            "store_hit_anomaly": store_hit_anomaly,
+            "scrape_errors": scrape_errors,
+            "scrape_error_rate": round(scrape_error_rate, 6),
+            "replicas_up": replicas_up,
+            "replicas_total": replicas_total,
+            "tenants": tenants,
+            "scale_advice": advice,
+            "reasons": reasons,
+        }
+        if ledger is not None:
+            ledger.event("fleet_signals", **rec)
+        return rec
+
+    def summary(self) -> Dict[str, Any]:
+        """The end-of-run roll-up the loadgen records: how often each
+        advice fired and how many evaluations burned."""
+        return {
+            "evaluations": self.evaluations,
+            "burn_alerts": self.burn_alerts,
+            "advice": dict(self.advice_counts),
+        }

@@ -35,6 +35,13 @@ DDIM inversions per edit of the same clip. This package keeps both warm:
     :class:`Router` that load-balances on ``/healthz``/``/metrics``,
     routes around open circuit breakers, retries deterministically and
     aggregates fleet health (``cli/router.py`` is the entry point).
+  * :mod:`videop2p_tpu.serve.collector` — the fleet telemetry plane's
+    ingest half (ISSUE 17): :class:`FleetCollector` scrapes every
+    replica's and the router's ``/healthz`` + ``/metrics`` on a fixed
+    interval into a bounded :class:`~videop2p_tpu.obs.tsdb.
+    TimeSeriesStore` (gaps recorded for dead replicas, never
+    interpolated) and evaluates ``obs/signals.py`` burn-rate/trend/
+    demand signals on the same cadence.
   * :mod:`videop2p_tpu.serve.faults` — the resilience layer's primitives
     (ISSUE 9): deterministic fault injection (:class:`FaultPlan`), the
     jitter-free :class:`RetryPolicy`, the :class:`CircuitBreaker`, and the
@@ -54,6 +61,7 @@ from videop2p_tpu.serve.batching import (
     unstack_outputs,
 )
 from videop2p_tpu.serve.client import EngineClient, engine_available
+from videop2p_tpu.serve.collector import FleetCollector
 from videop2p_tpu.serve.engine import TERMINAL_STATUSES, EditEngine, EditRequest
 from videop2p_tpu.serve.faults import (
     CircuitBreaker,
@@ -92,6 +100,7 @@ __all__ = [
     "unstack_outputs",
     "EngineClient",
     "engine_available",
+    "FleetCollector",
     "EditEngine",
     "EditRequest",
     "TERMINAL_STATUSES",

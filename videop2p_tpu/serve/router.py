@@ -114,6 +114,15 @@ class _ReplicaView:
             self._probe = (time.perf_counter(), health, metrics)
         return health, metrics
 
+    def probe_age(self) -> Optional[float]:
+        """Seconds since the cached probe was TAKEN (None before the
+        first probe) — stamped on the aggregated ``/metrics`` so a
+        scraper can tell TTL-cached gauges from fresh ones."""
+        with self._lock:
+            if self._probe is None:
+                return None
+            return max(time.perf_counter() - self._probe[0], 0.0)
+
     def invalidate(self) -> None:
         with self._lock:
             self._probe = None
@@ -391,7 +400,13 @@ class Router:
         fleet_requests: Dict[str, int] = {}
         for v in self.views:
             _, metrics = v.probe(self.probe_ttl_s)
-            per[v.name] = {"url": v.url, "routed": v.routed, **metrics}
+            age = v.probe_age()
+            per[v.name] = {"url": v.url, "routed": v.routed, **metrics,
+                           # how stale the snapshot is: 0-ish right after
+                           # the probe above ran, up to probe_ttl_s when
+                           # the TTL cache answered (ISSUE 17)
+                           "probe_age_s": (round(age, 6)
+                                           if age is not None else None)}
             for status, n in (metrics.get("requests") or {}).items():
                 fleet_requests[status] = fleet_requests.get(status, 0) + int(n)
         return {
