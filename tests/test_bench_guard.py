@@ -514,7 +514,10 @@ def test_report_and_obs_import_only_stdlib_numpy_jax():
     files = [os.path.join(_REPO, "tools", "edit_report.py"),
              # ISSUE 17 pin: the fleet dashboard renders on any box the
              # collector runs on — stdlib+numpy SVG, no plotting stack
-             os.path.join(_REPO, "tools", "fleet_dash.py")]
+             os.path.join(_REPO, "tools", "fleet_dash.py"),
+             # ISSUE 18 pin: the post-mortem renderer must open a bundle
+             # anywhere — it ships in bug reports, not deployments
+             os.path.join(_REPO, "tools", "incident_report.py")]
     obs_dir = os.path.join(_REPO, "videop2p_tpu", "obs")
     obs_files = sorted(f for f in os.listdir(obs_dir) if f.endswith(".py"))
     # ISSUE 6 pins: the time-domain modules are IN the guarded set — the
@@ -526,9 +529,13 @@ def test_report_and_obs_import_only_stdlib_numpy_jax():
     # ISSUE 17 pins: the telemetry plane joins — the time-series store
     # and the signal engine must never grow a prometheus_client/pandas
     # path; the fleet ships its own tsdb
+    # ISSUE 18 pins: the incident plane joins — the flight recorder is
+    # on the ledger hot path and the capture manager runs in every
+    # serving process, so both stay stdlib(+numpy via the sidecar)
     assert {"timing.py", "trace.py",
             "spans.py", "slo.py", "prom.py",
-            "tsdb.py", "signals.py"} <= set(obs_files)
+            "tsdb.py", "signals.py",
+            "flight.py", "incident.py"} <= set(obs_files)
     files += [os.path.join(obs_dir, f) for f in obs_files]
     # ISSUE 7 pins: the serving subsystem is IN the guarded set — the
     # HTTP layer stays stdlib http.server/urllib (no flask/requests), and
@@ -869,6 +876,10 @@ def test_fleet_signals_and_series_ledger_event_schema(tmp_path):
         ts.add(S_TENANT, t, float(i),
                {**lab, "tenant": "A", "field": "submitted"})
         ts.add(S_TENANT, t, float(i), {**lab, "tenant": "A", "field": "done"})
+    # ISSUE 18 satellite: reservoir trace-id exemplars thread into the
+    # evaluation record and the burn-alert reason NAMES a trace
+    eng.set_exemplars({"edit": {"p99_trace_id": "tid-p99",
+                                "max_trace_id": "tid-max"}})
     path = str(tmp_path / "ledger.jsonl")
     with RunLedger(path) as led:
         rec = eng.evaluate(5.5, ledger=led)
@@ -877,6 +888,8 @@ def test_fleet_signals_and_series_ledger_event_schema(tmp_path):
     assert set(rec) == set(FLEET_SIGNALS_FIELDS)
     assert rec["burn_alert"] is True and rec["scale_advice"] == "grow"
     assert set(rec["tenants"]["A"]) == set(FLEET_TENANT_FIELDS)
+    assert rec["exemplars"]["edit"]["p99_trace_id"] == "tid-p99"
+    assert any("tid-p99" in r for r in rec["reasons"])
     by_kind = {e["event"]: e for e in read_ledger(path)}
     assert set(FLEET_SIGNALS_FIELDS) <= set(by_kind["fleet_signals"])
     assert set(FLEET_SERIES_FIELDS) <= set(by_kind["fleet_series"])
@@ -888,6 +901,62 @@ def test_fleet_signals_and_series_ledger_event_schema(tmp_path):
     assert sig["fleet:series"]["samples"] > 0.0
     # pre-PR-17 ledgers extract an empty (but present) signals section
     assert extract_run([{"event": "run_start"}])["signals"] == {}
+
+
+def test_incident_ledger_event_schema(tmp_path):
+    """Schema pin (ISSUE 18): the ``incident`` ledger event carries
+    INCIDENT_FIELDS, INCIDENT_RULES ride in DEFAULT_RULES (kind
+    "incident", any-increase), and obs/history.py extracts the
+    ``incidents`` section with the overall label SEEDED at zero — a
+    healthy baseline must hold the label so a chaos run's first bundle
+    regresses against it with obs_diff exit-1 teeth."""
+    from videop2p_tpu.obs import RunLedger, read_ledger
+    from videop2p_tpu.obs.history import (
+        DEFAULT_RULES,
+        INCIDENT_RULES,
+        evaluate_rules,
+        extract_run,
+        split_runs,
+    )
+    from videop2p_tpu.obs.incident import (
+        INCIDENT_FIELDS,
+        INCIDENT_TRIGGERS,
+        IncidentManager,
+    )
+
+    assert all(r in DEFAULT_RULES for r in INCIDENT_RULES)
+    assert all(r.kind == "incident" for r in INCIDENT_RULES)
+    assert {r.metric for r in INCIDENT_RULES} == {"count", "suppressed"}
+    assert all(r.threshold_pct == 0.0 for r in INCIDENT_RULES)
+    assert set(INCIDENT_TRIGGERS) == {
+        "burn_alert", "breaker_open", "deadline_exceeded",
+        "window_poisoned", "crash", "sigusr1"}
+
+    path = str(tmp_path / "ledger.jsonl")
+    mgr = IncidentManager(str(tmp_path / "inc"), cooldown_s=3600.0,
+                          crash_hooks=False)
+    with RunLedger(path) as led:
+        mgr.attach_ledger(led)
+        led.event("fault", kind="dispatch_error", error="boom")
+        bundle = mgr.trigger("breaker_open", detail="closed->open")
+        assert mgr.trigger("breaker_open", detail="flap") is None  # debounced
+    assert bundle is not None and os.path.isdir(bundle)
+    by_kind = {e["event"]: e for e in read_ledger(path)}
+    assert set(INCIDENT_FIELDS) <= set(by_kind["incident"])
+    assert by_kind["incident"]["trigger"] == "breaker_open"
+
+    run = extract_run(split_runs(read_ledger(path))[-1])
+    assert run["incidents"]["incident"]["count"] == 1.0
+    assert run["incidents"]["incident:breaker_open"]["count"] == 1.0
+    # a run with NO incident events still extracts the seeded zero label
+    healthy = extract_run([{"event": "run_start"}])
+    assert healthy["incidents"] == {
+        "incident": {"count": 0.0, "suppressed": 0.0, "events": 0.0}}
+    # verdict teeth: healthy vs incident regresses; self-compare passes
+    assert not evaluate_rules(healthy, run)["pass"]
+    assert evaluate_rules(run, run)["pass"]
+    assert evaluate_rules(healthy, healthy)["pass"]
+    mgr.close()
 
 
 def test_router_and_tenant_ledger_event_schema(tmp_path):

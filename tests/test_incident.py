@@ -1,0 +1,461 @@
+"""Incident-plane tests (ISSUE 18): the always-on flight recorder
+(bounded thread-safe ring teed from ``RunLedger.event``, recorder-off
+path bit-exact), the :class:`IncidentManager`'s debounced declarative
+triggers and atomic content-addressed capture bundles, the crash hooks
+(subprocess e2e + in-process SIGUSR1), ledger-rotation interplay (the
+ring keeps the recent history the rotated file shifted away), the
+``fault_log`` most-recent-wins ring, and THE acceptance: a 2-replica
+in-process fleet sharing ONE manager — the healthy run captures ZERO
+incidents and self-compares clean through obs_diff, the chaos run
+(``unavail@`` plan) trips the breaker into exactly ONE debounced bundle
+whose post-mortem HTML names the trigger and a reservoir trace-id
+exemplar, and the chaos ledger regresses against the healthy baseline
+with exit-1 teeth.
+"""
+
+import importlib.util
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from videop2p_tpu.obs.flight import FLIGHT_DEFAULT_CAPACITY, FlightRecorder
+from videop2p_tpu.obs.incident import (
+    INCIDENT_FIELDS,
+    INCIDENT_TRIGGERS,
+    IncidentManager,
+)
+from videop2p_tpu.obs.ledger import RunLedger, read_ledger
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        f"{name}_under_incident_test", os.path.join(_REPO, "tools", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _bundles(root):
+    return sorted(
+        d for d in os.listdir(root)
+        if d.startswith("incident_") and not d.endswith(".tmp")
+        and os.path.isdir(os.path.join(root, d))
+    )
+
+
+# --------------------------------------------------------- flight ring --
+
+
+def test_flight_ring_is_bounded_thread_safe_and_accounted():
+    ring = FlightRecorder(capacity=64)
+    assert ring.capacity == 64
+
+    def hammer(worker):
+        for i in range(500):
+            ring.record({"event": "load", "worker": worker, "i": i})
+
+    threads = [threading.Thread(target=hammer, args=(w,)) for w in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # bounded no matter the load; accounting never loses a record
+    assert len(ring) == 64
+    st = ring.stats()
+    assert st == {"capacity": 64, "buffered": 64, "seen": 2000,
+                  "dropped": 1936}
+    # snapshot is oldest-first and per-worker ordered (appends are atomic)
+    snap = ring.snapshot()
+    assert len(snap) == 64
+    for w in range(4):
+        idxs = [e["i"] for e in snap if e["worker"] == w]
+        assert idxs == sorted(idxs)
+    # the probe measures on a scratch ring — real history is untouched
+    assert ring.overhead_probe(n=64) > 0.0
+    assert ring.stats()["seen"] == 2000
+    # a record that can't even be appended is swallowed, never raised
+    ring.record(None)  # dict-shaped or not, the plane keeps flying
+    assert FlightRecorder(capacity=0).capacity == 1  # floor, not a crash
+    assert FLIGHT_DEFAULT_CAPACITY == 2048
+
+
+def test_ledger_flight_tee_is_bit_exact_and_mirrors_events(tmp_path):
+    """The tee contract: attaching a recorder changes the written JSONL
+    by NOTHING (same records, byte-identical lines modulo the monotonic
+    ``t`` anchor), and the ring holds exactly the records the ledger
+    wrote, most recent last."""
+
+    def drive(led):
+        led.event("fault", kind="dispatch_fail", detail="attempt=2")
+        led.event("breaker", state_from="closed", state_to="open")
+        for i in range(5):
+            led.event("span", name="serve.dispatch", i=i)
+        led.close()
+
+    plain = RunLedger(str(tmp_path / "plain.jsonl"), run_id="r0",
+                      device_info=False)
+    drive(plain)
+
+    teed = RunLedger(str(tmp_path / "teed.jsonl"), run_id="r0",
+                     device_info=False)
+    ring = FlightRecorder(capacity=4)
+    teed.flight = ring
+    drive(teed)
+
+    def canon(path):
+        out = []
+        for e in read_ledger(path):
+            e.pop("t", None)  # monotonic anchor: the only run-varying field
+            out.append(e)
+        return out
+
+    assert canon(str(tmp_path / "plain.jsonl")) == canon(
+        str(tmp_path / "teed.jsonl"))
+    # the ring mirrors the written stream — INCLUDING close()'s run_end —
+    # last `capacity` records, in order
+    snap = ring.snapshot()
+    assert [e["event"] for e in snap] == ["span"] * 3 + ["run_end"]
+    assert ring.stats()["seen"] == 8 and ring.stats()["dropped"] == 4
+    # and the ring dump is itself a replayable ledger
+    n = ring.dump_jsonl(str(tmp_path / "ring.jsonl"))
+    replay = read_ledger(str(tmp_path / "ring.jsonl"))
+    assert n == 4 and [e["event"] for e in replay] == [
+        e["event"] for e in snap]
+
+
+def test_rotation_interplay_ring_outlives_rotated_segments(tmp_path):
+    """A tiny ``max_bytes`` ledger rotates mid-run: the on-disk tail file
+    only has the newest segment, but the flight ring kept the recent
+    history ACROSS the seam — and the rotated chain still extracts the
+    incident exactly once."""
+    from videop2p_tpu.obs.history import extract_run
+
+    led = RunLedger(str(tmp_path / "rot.jsonl"), run_id="rot",
+                    device_info=False, max_bytes=2048)
+    mgr = IncidentManager(str(tmp_path / "inc"), capacity=512)
+    mgr.attach_ledger(led)
+    for i in range(200):
+        led.event("span", name="serve.queue", i=i, pad="x" * 40)
+    assert led._rotations >= 1
+    assert os.path.exists(str(tmp_path / "rot.1.jsonl"))
+
+    bundle = mgr.trigger("deadline_exceeded", detail="watchdog fired")
+    assert bundle is not None
+    led.close()
+    mgr.close()
+
+    # the bundle's ring dump holds the full recent window, seam-free
+    flight = read_ledger(os.path.join(bundle, "flight.jsonl"))
+    spans = [e for e in flight if e.get("event") == "span"]
+    assert spans[-1]["i"] == 199
+    assert len(spans) > 100  # far more than the post-rotation tail file
+    tail_only = []
+    with open(str(tmp_path / "rot.jsonl")) as f:
+        for line in f:
+            if '"span"' in line:
+                tail_only.append(line)
+    assert len(spans) > len(tail_only)
+
+    # read_ledger stitches the chain; the incident extracts exactly once
+    events = read_ledger(str(tmp_path / "rot.jsonl"))
+    assert sum(1 for e in events if e.get("event") == "ledger_rotated") >= 1
+    run = extract_run(events)
+    inc = run["incidents"]
+    assert inc["incident"]["count"] == 1.0
+    assert inc["incident:deadline_exceeded"]["count"] == 1.0
+
+
+# ------------------------------------------------------ incident manager --
+
+
+def test_incident_bundle_contents_debounce_and_degraded_probes(tmp_path):
+    from videop2p_tpu.obs.tsdb import TimeSeriesStore, load_series_sidecar
+
+    ts = TimeSeriesStore()
+    for i in range(8):
+        ts.add("queue_depth", float(i), float(i % 3), {"replica": "replica0"})
+    mgr = IncidentManager(
+        str(tmp_path / "inc"), tsdb=ts, cooldown_s=3600.0,
+        cooldowns={"sigusr1": 0.0},
+    )
+    led = RunLedger(str(tmp_path / "led.jsonl"), run_id="unit",
+                    device_info=False)
+    mgr.attach_ledger(led)
+    mgr.note_fingerprint("engine:unit", "fp-abc")
+    mgr.register_target("engine:unit",
+                        lambda: {"healthz": {"status": "ok"}, "metrics": {}})
+    mgr.register_target("router:dead",
+                        lambda: (_ for _ in ()).throw(OSError("conn refused")))
+    mgr.register_exemplars(
+        lambda: {"edit_fused": {"p99_trace_id": "tid-a", "max_trace_id":
+                                "tid-b", "count": 3}})
+    led.event("fault", kind="hang", detail="attempt=5")
+
+    bundle = mgr.trigger("breaker_open", detail="closed->open",
+                         extra_files={"../escape/crash.txt": "boom"},
+                         trips=1)
+    assert bundle is not None and os.path.isdir(bundle)
+    # debounced duplicates: suppressed, counted, no second bundle
+    assert mgr.trigger("breaker_open", detail="flap") is None
+    assert mgr.trigger("breaker_open", detail="flap") is None
+    # an independent trigger with its own 0s cooldown still fires
+    assert mgr.trigger("sigusr1", detail="on demand") is not None
+    assert len(_bundles(str(tmp_path / "inc"))) == 2
+
+    files = sorted(os.listdir(bundle))
+    assert files == ["crash.txt", "flight.jsonl", "manifest.json",
+                     "series.npz", "targets.json"]  # basename-sanitized
+    man = json.load(open(os.path.join(bundle, "manifest.json")))
+    assert man["trigger"] == "breaker_open"
+    assert man["fingerprints"] == {"engine:unit": "fp-abc"}
+    assert man["context"] == {"trips": 1}
+    assert man["exemplars"]["edit_fused"]["p99_trace_id"] == "tid-a"
+    assert man["flight"]["buffered"] == 1 and man["flight_record_ns"] > 0
+    assert man["bundle_id"] in os.path.basename(bundle)
+    assert man["series"]["label"] == "breaker_open"
+    series = load_series_sidecar(os.path.join(bundle, "series.npz"))
+    assert any("queue_depth" in k for k in series)
+    targets = json.load(open(os.path.join(bundle, "targets.json")))
+    assert targets["engine:unit"]["healthz"]["status"] == "ok"
+    assert "conn refused" in targets["router:dead"]["error"]
+    flight = read_ledger(os.path.join(bundle, "flight.jsonl"))
+    assert [e["event"] for e in flight] == ["fault"]
+
+    # the mirrored ledger event carries exactly INCIDENT_FIELDS
+    led.close()
+    incs = [e for e in read_ledger(led.path) if e.get("event") == "incident"]
+    assert len(incs) == 2  # breaker_open + sigusr1 (debounced never logs)
+    assert set(incs[0]) == {"event", "t", *INCIDENT_FIELDS}
+    assert incs[0]["suppressed"] == 0 and incs[0]["events"] == 1
+    assert mgr.records()[0]["trigger"] == "breaker_open"
+    assert mgr.summary()["by_trigger"] == {"breaker_open": 1, "sigusr1": 1}
+    assert mgr.summary()["suppressed"] == {"breaker_open": 2}
+
+    # suppressed count is carried into the NEXT bundle of that trigger
+    mgr.cooldowns["breaker_open"] = 0.0
+    b2 = mgr.trigger("breaker_open", detail="third")
+    assert b2 is not None
+    assert json.load(open(os.path.join(
+        b2, "manifest.json")))["suppressed_since_last"] == 2
+
+    # closed manager: triggers are inert, never raising
+    mgr.close()
+    assert mgr.trigger("crash", detail="after close") is None
+    assert set(INCIDENT_TRIGGERS) >= {"breaker_open", "crash", "sigusr1"}
+
+
+def test_sigusr1_on_demand_capture_and_hook_restore(tmp_path):
+    prev_hook = sys.excepthook
+    mgr = IncidentManager(str(tmp_path / "inc"), crash_hooks=True,
+                          cooldowns={"sigusr1": 0.0})
+    try:
+        assert sys.excepthook is not prev_hook  # chained
+        os.kill(os.getpid(), signal.SIGUSR1)
+        deadline = time.perf_counter() + 5.0
+        while not _bundles(str(tmp_path / "inc")):
+            if time.perf_counter() > deadline:
+                pytest.fail("SIGUSR1 capture never landed")
+            time.sleep(0.01)
+        bundle = os.path.join(str(tmp_path / "inc"),
+                              _bundles(str(tmp_path / "inc"))[0])
+        man = json.load(open(os.path.join(bundle, "manifest.json")))
+        assert man["trigger"] == "sigusr1"
+        assert os.path.exists(os.path.join(str(tmp_path / "inc"),
+                                           "faulthandler.log"))
+    finally:
+        mgr.close()
+    assert sys.excepthook is prev_hook  # restored, not clobbered
+
+
+def test_crash_excepthook_dumps_bundle_from_subprocess(tmp_path):
+    """E2E: an unhandled exception in a real interpreter writes a crash
+    bundle (traceback + all-threads faulthandler dump) before the
+    process dies nonzero."""
+    root = str(tmp_path / "crash_inc")
+    script = (
+        "import sys; sys.path.insert(0, sys.argv[1])\n"
+        "from videop2p_tpu.obs.incident import IncidentManager\n"
+        "mgr = IncidentManager(sys.argv[2], crash_hooks=True,\n"
+        "                      cooldowns={'crash': 0.0})\n"
+        "raise ValueError('injected unhandled crash')\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", script, _REPO, root],
+        capture_output=True, text=True, timeout=120.0,
+    )
+    assert proc.returncode != 0
+    assert "injected unhandled crash" in proc.stderr  # chained prev hook ran
+    names = _bundles(root)
+    assert len(names) == 1
+    bundle = os.path.join(root, names[0])
+    man = json.load(open(os.path.join(bundle, "manifest.json")))
+    assert man["trigger"] == "crash"
+    assert "ValueError" in man["detail"]
+    crash = open(os.path.join(bundle, "crash.txt")).read()
+    assert "injected unhandled crash" in crash
+    assert "faulthandler (all threads)" in crash
+
+
+# ------------------------------------------------------- engine satellite --
+
+_SPEC_KW = dict(checkpoint=None, tiny=True, width=16, video_len=2, steps=2)
+
+_PROMPTS = ("a rabbit is jumping", "a origami rabbit is jumping")
+
+
+def test_fault_log_ring_tail_survives_thousand_faults(tmp_path):
+    """ISSUE 18 satellite: ``EditEngine.fault_log`` is a most-recent-wins
+    ring — after 1000 injected faults the LAST 256 entries (the ones an
+    incident bundle needs) survive, not the first 256."""
+    from videop2p_tpu.serve import EditEngine, ProgramSet, ProgramSpec
+    from videop2p_tpu.serve.engine import _FAULT_LOG_MAX
+
+    spec = ProgramSpec(**_SPEC_KW)
+    eng = EditEngine(spec, programs=ProgramSet(spec),  # never dispatched
+                     out_dir=str(tmp_path / "out"))
+    try:
+        for i in range(1000):
+            eng._fault_event("dispatch_fail", attempt=i)
+        log = list(eng.fault_log)
+        assert len(log) == _FAULT_LOG_MAX == 256
+        assert log[-1]["detail"] == "attempt=999"   # newest survives
+        assert log[0]["detail"] == "attempt=744"    # oldest 744 evicted
+        assert eng.counters["faults_injected"] == 1000  # counters: unbounded
+    finally:
+        eng.close()
+
+
+# ------------------------------------------------- THE chaos acceptance --
+
+
+@pytest.fixture(scope="module")
+def programs():
+    from videop2p_tpu.serve import ProgramSet, ProgramSpec
+
+    ps = ProgramSet(ProgramSpec(**_SPEC_KW))
+    ps.warm(_PROMPTS, batch_sizes=(2,))
+    return ps
+
+
+def _request(**overrides):
+    from videop2p_tpu.serve import EditRequest
+
+    kw = dict(image_path="data/rabbit", prompt=_PROMPTS[0],
+              prompts=list(_PROMPTS), save_name="incident")
+    kw.update(overrides)
+    return EditRequest(**kw)
+
+
+@pytest.mark.slow
+def test_incident_acceptance_two_replica_fleet_healthy_vs_chaos(
+        programs, tmp_path):
+    """THE ISSUE 18 acceptance: a 2-replica in-process fleet shares ONE
+    IncidentManager. Healthy run: zero incidents, zero bundles, obs_diff
+    self-compare exit 0. Chaos run (replica0 under an ``unavail@`` plan):
+    the breaker trips open into exactly ONE debounced bundle, replica1
+    keeps serving, the post-mortem HTML names the trigger AND a reservoir
+    trace-id exemplar, and the chaos ledger regresses against the healthy
+    baseline through obs_diff with exit 1."""
+    from videop2p_tpu.obs.tsdb import TimeSeriesStore
+    from videop2p_tpu.serve import EditEngine, ProgramSpec
+    from videop2p_tpu.serve.faults import FaultPlan
+
+    obs_diff = _load_tool("obs_diff")
+
+    # ---- healthy baseline fleet -----------------------------------------
+    h_mgr = IncidentManager(str(tmp_path / "h_inc"),
+                            tsdb=TimeSeriesStore())
+    healthy = [
+        EditEngine(ProgramSpec(**_SPEC_KW), programs=programs,
+                   out_dir=str(tmp_path / f"h{i}"), tracing=True,
+                   incidents=h_mgr)
+        for i in range(2)
+    ]
+    try:
+        for eng in healthy:
+            r = eng.result(eng.submit(_request()), wait_s=300.0)
+            assert r["status"] == "done", r.get("error")
+    finally:
+        for eng in healthy:
+            eng.close()
+    h_mgr.close()
+    assert h_mgr.records() == []                      # zero incidents
+    assert _bundles(str(tmp_path / "h_inc")) == []    # zero bundles
+    healthy_ledger = healthy[0].ledger.path
+    assert obs_diff.main(["obs_diff.py", healthy_ledger, healthy_ledger]) == 0
+
+    # ---- chaos fleet: replica0's backend goes away ----------------------
+    c_mgr = IncidentManager(str(tmp_path / "c_inc"),
+                            tsdb=TimeSeriesStore())
+    # dispatch ledger on replica0 (1-based): R1=1 ok (seeds the latency
+    # reservoir with a trace-id exemplar) | R2=2,3 unavailable (1 retry
+    # exhausted -> error, breaker failure #1) | R3=4,5 unavailable ->
+    # breaker failure #2 trips OPEN -> THE incident
+    sick = EditEngine(
+        ProgramSpec(**_SPEC_KW), programs=programs,
+        out_dir=str(tmp_path / "c0"), tracing=True, incidents=c_mgr,
+        max_retries=1, retry_base_s=0.01, retry_cap_s=0.05,
+        breaker_threshold=2, breaker_open_s=60.0,
+        faults=FaultPlan.parse("unavail@2-999"),
+    )
+    well = EditEngine(ProgramSpec(**_SPEC_KW), programs=programs,
+                      out_dir=str(tmp_path / "c1"), tracing=True,
+                      incidents=c_mgr)
+    try:
+        r1 = sick.result(sick.submit(_request()), wait_s=300.0)
+        assert r1["status"] == "done", r1.get("error")
+        for _ in range(2):
+            r = sick.result(sick.submit(_request()), wait_s=300.0)
+            assert r["status"] == "error"
+        assert sick.breaker.state == "open"
+        # the healthy replica keeps serving through its peer's outage
+        rw = well.result(well.submit(_request()), wait_s=300.0)
+        assert rw["status"] == "done", rw.get("error")
+    finally:
+        sick.close()
+        well.close()
+    chaos_ledger = sick.ledger.path
+    c_mgr.close()
+
+    # exactly ONE debounced breaker bundle for the whole fleet
+    names = _bundles(str(tmp_path / "c_inc"))
+    assert len(names) == 1
+    recs = c_mgr.records()
+    assert len(recs) == 1 and recs[0]["trigger"] == "breaker_open"
+    bundle = os.path.join(str(tmp_path / "c_inc"), names[0])
+    man = json.load(open(os.path.join(bundle, "manifest.json")))
+    assert man["trigger"] == "breaker_open"
+    assert "consecutive dispatch failures" in man["detail"]
+    # both replicas' fingerprints + targets made it into the one bundle
+    assert len(man["fingerprints"]) == 2
+    targets = json.load(open(os.path.join(bundle, "targets.json")))
+    assert len(targets) == 2
+    # the reservoir exemplar NAMES the trace that dispatched successfully
+    exemplars = [v for v in man["exemplars"].values()
+                 if v.get("p99_trace_id")]
+    assert exemplars, man["exemplars"]
+    tid = exemplars[0]["p99_trace_id"]
+    # the flight ring captured the breaker transition itself
+    flight = read_ledger(os.path.join(bundle, "flight.jsonl"))
+    assert any(e.get("event") == "breaker" and e.get("state_to") == "open"
+               for e in flight)
+
+    # post-mortem HTML: names the trigger and the exemplar trace
+    incident_report = _load_tool("incident_report")
+    out = incident_report.write_report(bundle)
+    html = open(out).read()
+    assert "breaker_open" in html
+    assert tid in html
+
+    # verdict teeth: chaos regresses vs healthy; each self-compare is clean
+    assert obs_diff.main(["obs_diff.py", healthy_ledger, chaos_ledger]) == 1
+    assert obs_diff.main(["obs_diff.py", chaos_ledger, chaos_ledger]) == 0

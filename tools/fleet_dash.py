@@ -78,7 +78,8 @@ def _burn_gauge(label: str, burn: float, threshold: float,
 
 
 def _advice_timeline(sigs: Sequence[Dict[str, Any]], w_cell: int = 14,
-                     h: int = 26) -> str:
+                     h: int = 26,
+                     incidents: Sequence[Dict[str, Any]] = ()) -> str:
     if not sigs:
         return ""
     cells = []
@@ -92,10 +93,31 @@ def _advice_timeline(sigs: Sequence[Dict[str, Any]], w_cell: int = 14,
             f'<rect x="{i * w_cell}" y="2" width="{w_cell - 2}" '
             f'height="{h - 4}" fill="{color}">'
             f"<title>{html.escape(title)}</title></rect>")
+    # incident markers (ISSUE 18): a red diamond over the evaluation the
+    # capture landed in (matched by ledger `t`), tooltip carrying trigger
+    # kind + bundle path — the dashboard names the evidence directory
+    for inc in incidents:
+        it = inc.get("t")
+        idx = len(sigs) - 1
+        if isinstance(it, (int, float)):
+            idx = next((i for i, e in enumerate(sigs)
+                        if isinstance(e.get("t"), (int, float))
+                        and e["t"] >= it), len(sigs) - 1)
+        cx = idx * w_cell + (w_cell - 2) / 2
+        title = (f"incident {inc.get('trigger', '?')}: "
+                 f"{inc.get('detail', '')} — bundle "
+                 f"{inc.get('bundle', '?')}")
+        cells.append(
+            f'<path d="M {cx:.1f} 0 l 5 6 l -5 6 l -5 -6 z" '
+            f'fill="#b22222" stroke="#fff" stroke-width="1">'
+            f"<title>{html.escape(title)}</title></path>")
     w = len(sigs) * w_cell
     legend = " ".join(
         f'<span style="color:{c}">■</span> {a}'
         for a, c in _ADVICE_COLOR.items())
+    if incidents:
+        legend += (' <span style="color:#b22222">◆</span> '
+                   f"incident ({len(incidents)})")
     return (f'<div class=row><svg width="{w}" height="{h}">'
             + "".join(cells) + f"</svg><span class=meta> {legend}</span></div>")
 
@@ -125,6 +147,7 @@ def render_dash(events: Sequence[Dict[str, Any]],
     events = [e for e in events if isinstance(e, dict)]
     start = next((e for e in events if e.get("event") == "run_start"), {})
     sigs = [e for e in events if e.get("event") == "fleet_signals"]
+    incidents = [e for e in events if e.get("event") == "incident"]
     snap = next((e for e in reversed(events)
                  if e.get("event") == "fleet_series"), None)
     body: List[str] = [
@@ -156,7 +179,7 @@ def render_dash(events: Sequence[Dict[str, Any]],
             label=f"fast-burn history, alerts fired "
                   f"{_fmt(last.get('burn_alerts'))}") + "</div>")
         body.append("<h2>Scale advice</h2>")
-        body.append(_advice_timeline(sigs))
+        body.append(_advice_timeline(sigs, incidents=incidents))
         body.append(
             f"<p class=meta>last advice: "
             f"<b>{html.escape(str(last.get('scale_advice', '?')))}</b>"
@@ -185,6 +208,17 @@ def render_dash(events: Sequence[Dict[str, Any]],
                         "the scraped dispatch p50.</p>"
                         + _table(trows, ["tenant", "submit/s", "served/s",
                                          "shed/s", "device_s"]))
+    if incidents:
+        irows = [[_fmt(e.get("t", "")), str(e.get("trigger", "?")),
+                  str(e.get("detail", ""))[:120],
+                  str(e.get("bundle", "?")), _fmt(e.get("suppressed", 0))]
+                 for e in incidents]
+        body.append("<h2>Incidents</h2>"
+                    "<p class=meta>capture bundles this run — render one "
+                    "with tools/incident_report.py &lt;bundle&gt;.</p>"
+                    + _table(irows, ["t (s)", "trigger", "detail",
+                                     "bundle", "suppressed"],
+                             ["bad"] * len(irows)))
     if snap is not None:
         body.append("<h2>Series</h2>")
         body.append(

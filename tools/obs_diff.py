@@ -26,7 +26,9 @@ events — obs/spans.py: per-segment queue/resolve/dispatch/decode p50/p99
 regress by growing, gated by ``SEGMENT_RULES``), and SLO compliance
 (``slo_report`` events — obs/slo.py: per-objective error-budget burn
 regresses by growing, a compliant→violating flip always fails — gated by
-``SLO_RULES``)
+``SLO_RULES``), and captured incidents (``incident`` events —
+obs/incident.py: ANY increase in bundle or suppressed-capture counts,
+overall or per trigger kind, regresses — gated by ``INCIDENT_RULES``)
 between a baseline run and a new run, renders per-program tables,
 evaluates the declarative regression rules (obs/history.py DEFAULT_RULES;
 scale every threshold with ``--threshold-scale``), and:
@@ -398,6 +400,31 @@ def render_diff(base: Dict, new: Dict, result: Dict) -> str:
                 _table(rows, ["label", "burn_fast", "burn_slow", "alerts",
                               "saturation", "scrape_err_rate", "up",
                               "advice"])]
+
+    # incident section (incident events — obs/incident.py, ISSUE 18):
+    # the overall "incident" label is seeded at zero on every run, so the
+    # table only renders when either side actually captured something
+    incs = sorted(set(base.get("incidents") or {})
+                  | set(new.get("incidents") or {}))
+    inc_rows = []
+    for label in incs:
+        b = (base.get("incidents") or {}).get(label, {})
+        n = (new.get("incidents") or {}).get(label, {})
+        if not (b.get("count") or n.get("count")
+                or b.get("suppressed") or n.get("suppressed")):
+            continue
+        inc_rows.append([
+            label,
+            f"{_fmt(b.get('count', 0.0))} → {_fmt(n.get('count', 0.0))}",
+            f"{_fmt(b.get('suppressed', 0.0))} → "
+            f"{_fmt(n.get('suppressed', 0.0))}",
+            f"{_fmt(b.get('events', 0.0))} → {_fmt(n.get('events', 0.0))}",
+        ])
+    if inc_rows:
+        out += ["", "incidents (incident events — ANY increase in "
+                "captured or suppressed bundles regresses):",
+                _table(inc_rows, ["label", "bundles", "suppressed",
+                                  "ring_events"])]
 
     comp = sorted(set(base.get("compiles", {})) | set(new.get("compiles", {})))
     if comp:

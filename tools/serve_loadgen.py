@@ -476,6 +476,16 @@ def main(argv=None) -> int:
                          "(+ .npz sidecar in --out_dir) land in THIS "
                          "ledger — gate with obs_diff SIGNAL_RULES, "
                          "render with tools/fleet_dash.py")
+    ap.add_argument("--incidents", type=str, default=None, metavar="DIR",
+                    help="incident plane (ISSUE 18): ONE shared "
+                         "IncidentManager across the whole in-process "
+                         "fleet — every engine/router ledger tees into "
+                         "its flight ring, breaker-open/deadline/burn-"
+                         "alert/crash triggers write debounced capture "
+                         "bundles under DIR, and the incident events "
+                         "land in THIS ledger (obs_diff INCIDENT_RULES "
+                         "gate any increase) — render bundles with "
+                         "tools/incident_report.py")
     ap.add_argument("--scrape_interval_s", type=float, default=0.5,
                     help="collector scrape/evaluate cadence")
     ap.add_argument("--window_scale", type=float, default=1.0,
@@ -555,8 +565,21 @@ def main(argv=None) -> int:
     scrape_targets: List[Any] = []
     chaos = bool(args.faults or args.replica_faults)
 
+    incident_mgr = None
+    if args.incidents:
+        # one manager for the whole run: fleet-wide debounce (a breaker
+        # flapping on two replicas is ONE incident), crash hooks for the
+        # driver process, and every in-process engine ledger teeing into
+        # the same flight ring
+        from videop2p_tpu.obs.incident import IncidentManager
+
+        incident_mgr = IncidentManager(args.incidents, crash_hooks=True)
+        print(f"[loadgen] incident plane armed: bundles under "
+              f"{args.incidents}")
+
     def engine_kwargs():
         return dict(
+            incidents=incident_mgr,
             max_batch=args.max_batch,
             max_queue=args.max_queue,
             default_deadline_s=args.deadline_s,
@@ -629,7 +652,8 @@ def main(argv=None) -> int:
             router_ledger = os.path.join(args.out_dir,
                                          "router_ledger.jsonl")
         router = Router(supervisor.urls, probe_ttl_s=0.1,
-                        ledger_path=router_ledger, tracing=args.tracing)
+                        ledger_path=router_ledger, tracing=args.tracing,
+                        incidents=incident_mgr)
         router_server = RouterServer(router).start()
         target = _HttpTarget(router_server.url, args.timeout_s)
         scrape_targets = ([(r.name, r.url) for r in supervisor.replicas]
@@ -688,6 +712,7 @@ def main(argv=None) -> int:
             window_scale=args.window_scale,
             signal_kwargs=dict(
                 saturation_threshold=args.saturation_threshold),
+            incidents=incident_mgr,
         )
         collector.start()
         meta["collector"] = {"targets": [n for n, _ in scrape_targets],
@@ -719,6 +744,19 @@ def main(argv=None) -> int:
                                  **collector.stats()}
             return events
 
+    if incident_mgr is not None:
+        base_inc = collect_extra
+
+        def collect_extra(record, base=base_inc, mgr=incident_mgr):
+            # last wrapper: runs AFTER the collector drain, so a burn
+            # alert fired by the final evaluate still lands here — the
+            # incident events go into THIS ledger (INCIDENT_RULES teeth)
+            # and the summary names every bundle
+            events = list(base(record) or []) if base is not None else []
+            events += mgr.records()
+            record["incidents"] = mgr.summary()
+            return events
+
     mutate_request = None
     if args.distinct_seeds:
         # closed-loop cold traffic: unique seed per request issue index
@@ -745,6 +783,8 @@ def main(argv=None) -> int:
             supervisor.stop()
         if engine is not None:
             engine.close()
+        if incident_mgr is not None:
+            incident_mgr.close()
     print(json.dumps(record, default=str))
     min_rate = args.min_success_rate
     if min_rate is None and chaos:

@@ -50,6 +50,7 @@ def make_run_ledger(
     program_analysis: bool = True,
     enable: bool = False,
     set_latency_env: bool = True,
+    incidents: Optional[str] = None,
 ):
     """The shared obs-flags → :class:`~videop2p_tpu.obs.RunLedger` wiring.
 
@@ -66,7 +67,7 @@ def make_run_ledger(
     if not program_analysis:
         os.environ["VIDEOP2P_OBS_NO_ANALYSIS"] = "1"
     if not (enable or telemetry or ledger or attn_maps or quality or report
-            or device_telemetry or latency or trace_analysis):
+            or device_telemetry or latency or trace_analysis or incidents):
         return None
     if latency and set_latency_env:
         # pipeline-internal jits (the fused null-text cache) check the
@@ -83,9 +84,20 @@ def make_run_ledger(
         "trace_analysis": bool(trace_analysis),
     }
     base_meta.update(meta or {})
-    return RunLedger(
+    led = RunLedger(
         ledger or default_path, mesh=mesh, meta=base_meta, latency=latency
     ).activate()
+    if incidents:
+        # incident plane (ISSUE 18): the flight ring tees this ledger's
+        # events, and crash/SIGUSR1 hooks capture bundles for the whole
+        # CLI run — the manager rides the process lifetime (one-shot
+        # CLIs), so no explicit close is threaded back
+        from videop2p_tpu.obs.incident import IncidentManager
+
+        mgr = IncidentManager(str(incidents), crash_hooks=True)
+        mgr.attach_ledger(led)
+        led.incidents = mgr
+    return led
 
 
 def enable_compile_cache(env_var: str = "VIDEOP2P_COMPILE_CACHE") -> None:
@@ -331,6 +343,18 @@ def add_obs_args(parser: argparse.ArgumentParser) -> None:
              "grids, mask overlays, null-text loss sparkline, quality "
              "table, regression verdicts) next to the run's outputs — "
              "tools/edit_report.py re-renders it from the ledger+sidecar",
+    )
+    parser.add_argument(
+        "--incidents", type=str, default=None, metavar="DIR",
+        help="arm the incident plane (obs/incident.py): an always-on "
+             "flight recorder tees the run ledger's most recent events "
+             "into a bounded in-memory ring, and anomaly triggers (burn "
+             "alert, circuit-breaker open, dispatch deadline, poisoned "
+             "stream window, unhandled crash, SIGUSR1 on demand) write "
+             "debounced atomic capture bundles under DIR — flight-ring "
+             "JSONL, tsdb snapshot, /healthz+/metrics from every target, "
+             "manifest with fingerprints and trace-id exemplars. Render "
+             "a bundle with tools/incident_report.py",
     )
 
 

@@ -73,6 +73,12 @@ def build_parser() -> argparse.ArgumentParser:
                          "forward a child traceparent to the chosen "
                          "replica — run the replicas with --tracing too "
                          "and join the ledgers with tools/trace_view.py")
+    ap.add_argument("--incidents", type=str, default=None, metavar="DIR",
+                    help="arm the incident plane (obs/incident.py): the "
+                         "router ledger tees into a flight ring, replicas "
+                         "become bundle probe targets, and crash/SIGUSR1 "
+                         "triggers write debounced capture bundles under "
+                         "DIR — render with tools/incident_report.py")
     return ap
 
 
@@ -115,6 +121,7 @@ def main(argv=None) -> int:
         ledger_path=(args.ledger
                      or os.path.join(args.out_dir, "router_ledger.jsonl")),
         tracing=args.tracing,
+        incidents=args.incidents,
     )
     server = RouterServer(router, host=args.host, port=args.port)
     print(f"[router] listening on {server.url} over {len(urls)} replica(s):")

@@ -151,6 +151,9 @@ def main(
     # mined into a trace_analysis event by the stdlib xplane reader
     latency: bool = False,
     trace_analysis: bool = False,
+    # --incidents DIR arms the incident plane (obs/incident.py): flight-
+    # ring tee on the run ledger + crash/SIGUSR1 capture bundles
+    incidents: Optional[str] = None,
     # automatic XLA cost/memory analysis of each instrumented program on
     # compile (program_analysis ledger events; obs/introspect.py)
     program_analysis: bool = True,
@@ -180,7 +183,7 @@ def main(
         meta={"cli": "run_tuning", "max_train_steps": max_train_steps},
         telemetry=telemetry, device_telemetry=device_telemetry,
         latency=latency, trace_analysis=trace_analysis,
-        program_analysis=program_analysis,
+        program_analysis=program_analysis, incidents=incidents,
     )
 
     sampler = None
@@ -641,6 +644,7 @@ if __name__ == "__main__":
         device_telemetry=args.device_telemetry,
         latency=args.latency,
         trace_analysis=args.trace_analysis,
+        incidents=args.incidents,
     )
     if args.distill_steps > 0:
         run_distillation(

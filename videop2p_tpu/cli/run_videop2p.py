@@ -305,6 +305,9 @@ def main(
     # bit-exact (host-side measurement only).
     latency: bool = False,
     trace_analysis: bool = False,
+    # --incidents DIR arms the incident plane (obs/incident.py): flight-
+    # ring tee on the run ledger + crash/SIGUSR1 capture bundles
+    incidents: Optional[str] = None,
     # automatic XLA cost/memory analysis of each instrumented program on
     # compile (program_analysis ledger events; obs/introspect.py) — the
     # per-program peak-HBM estimate the memory snapshots are checked
@@ -351,7 +354,7 @@ def main(
               "null_text_mode": null_text_mode},
         telemetry=telemetry, attn_maps=attn_maps, quality=quality,
         report=report, device_telemetry=device_telemetry, latency=latency,
-        trace_analysis=trace_analysis,
+        trace_analysis=trace_analysis, incidents=incidents,
     )
 
     def maybe_trace(window_name: str):
@@ -975,4 +978,5 @@ if __name__ == "__main__":
         device_telemetry=args.device_telemetry,
         latency=args.latency,
         trace_analysis=args.trace_analysis,
+        incidents=args.incidents,
     )

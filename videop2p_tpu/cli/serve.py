@@ -184,6 +184,14 @@ def build_parser() -> argparse.ArgumentParser:
                          "availability, deadline-miss rate, served p99) "
                          "over the run at shutdown into slo_report ledger "
                          "events — obs_diff SLO_RULES gate budget burn")
+    # incident plane (ISSUE 18 — docs/OBSERVABILITY.md Layer 7)
+    ap.add_argument("--incidents", type=str, default=None, metavar="DIR",
+                    help="arm the incident plane (obs/incident.py): the "
+                         "flight recorder tees ledger events into a "
+                         "bounded ring, and breaker-open / dispatch-"
+                         "deadline / crash / SIGUSR1 triggers write "
+                         "debounced atomic capture bundles under DIR — "
+                         "render with tools/incident_report.py")
     return ap
 
 
@@ -231,6 +239,7 @@ def main(argv=None) -> int:
         faults=faults,
         tracing=args.tracing,
         slo=args.slo,
+        incidents=args.incidents,
     )
     if not args.no_warm:
         print(f"[serve] warming programs (spec {engine.spec.fingerprint()})...")

@@ -110,6 +110,13 @@ def build_parser() -> argparse.ArgumentParser:
                          "show as cached spans) plus the engine's full "
                          "per-request span tree — render with "
                          "tools/trace_view.py")
+    ap.add_argument("--incidents", type=str, default=None, metavar="DIR",
+                    help="arm the incident plane (obs/incident.py): the "
+                         "job ledger tees into a flight ring, and "
+                         "breaker-open / deadline / poisoned-window / "
+                         "crash triggers write debounced capture bundles "
+                         "under DIR (default off) — render with "
+                         "tools/incident_report.py")
     return ap
 
 
@@ -160,6 +167,7 @@ def main(argv=None) -> int:
         keep_videos=True,
         faults=faults,
         tracing=args.tracing,
+        incidents=args.incidents,
     )
     prompts = [args.prompt, args.edit_prompt]
     print(f"[stream] warming programs (spec {engine.spec.fingerprint()})...")

@@ -66,6 +66,18 @@ Pillars (ISSUEs 2–4):
     ``scale_advice`` — gated by ``SIGNAL_RULES`` in obs_diff
     (``serve/collector.py`` is the scrape loop, ``tools/fleet_dash.py``
     the dashboard).
+  * :mod:`videop2p_tpu.obs.flight` — the always-on flight recorder
+    (ISSUE 18): a bounded thread-safe ring of the most recent ledger
+    events, teed from :meth:`RunLedger.event` at one guarded deque
+    append (recorder-off path: a single ``None`` check, bit-exact).
+  * :mod:`videop2p_tpu.obs.incident` — anomaly-triggered capture
+    (ISSUE 18): declarative debounced triggers (burn alert, breaker
+    open, dispatch deadline, poisoned stream window, crash, SIGUSR1)
+    write atomic content-addressed incident bundles — flight-ring
+    JSONL, tsdb snapshot, target probes, manifest with fingerprints and
+    trace-id exemplars — plus ``incident`` ledger events gated by
+    ``INCIDENT_RULES`` (``tools/incident_report.py`` renders the
+    post-mortem).
   * :mod:`videop2p_tpu.obs.comm` — distributed observability (ISSUE 5):
     collective-communication accounting of sharded programs
     (``comm_analysis`` events with per-kind counts/bytes + sharding
@@ -97,10 +109,15 @@ from videop2p_tpu.obs.comm import (
     summarize_device_stats,
     tree_replica_divergence,
 )
+from videop2p_tpu.obs.flight import (
+    FLIGHT_DEFAULT_CAPACITY,
+    FlightRecorder,
+)
 from videop2p_tpu.obs.history import (
     COMM_RULES,
     DEFAULT_RULES,
     FAULT_RULES,
+    INCIDENT_RULES,
     QUALITY_RULES,
     SEGMENT_RULES,
     SIGNAL_RULES,
@@ -111,6 +128,11 @@ from videop2p_tpu.obs.history import (
     evaluate_rules,
     extract_run,
     split_runs,
+)
+from videop2p_tpu.obs.incident import (
+    INCIDENT_FIELDS,
+    INCIDENT_TRIGGERS,
+    IncidentManager,
 )
 from videop2p_tpu.obs.introspect import (
     analyze_compiled,
@@ -244,6 +266,12 @@ __all__ = [
     "engine_metrics_prometheus",
     "router_metrics_prometheus",
     "SIGNAL_RULES",
+    "INCIDENT_RULES",
+    "FLIGHT_DEFAULT_CAPACITY",
+    "FlightRecorder",
+    "INCIDENT_FIELDS",
+    "INCIDENT_TRIGGERS",
+    "IncidentManager",
     "FLEET_SERIES_FIELDS",
     "TimeSeriesStore",
     "load_series_sidecar",

@@ -46,6 +46,8 @@ __all__ = [
     "SEAM_RULES",
     "SLO_RULES",
     "SEGMENT_RULES",
+    "SIGNAL_RULES",
+    "INCIDENT_RULES",
     "split_runs",
     "extract_run",
     "evaluate_rules",
@@ -237,6 +239,20 @@ SIGNAL_RULES: Tuple[RegressionRule, ...] = (
                    min_abs=0.5),
 )
 
+# incident gates (ISSUE 18): ANY increase in captured incidents —
+# overall or per trigger kind — regresses the run. The healthy baseline
+# is zero bundles, so threshold_pct=0 with a 0.5 floor means one new
+# incident is one verdict; a zero-incident self-compare stays clean.
+# Suppressed (debounced) captures gate too: a run that went from "one
+# bundle" to "one bundle plus forty suppressed repeats" got worse even
+# though the bundle count held.
+INCIDENT_RULES: Tuple[RegressionRule, ...] = (
+    RegressionRule("count", kind="incident", threshold_pct=0.0,
+                   min_abs=0.5),
+    RegressionRule("suppressed", kind="incident", threshold_pct=0.0,
+                   min_abs=0.5),
+)
+
 DEFAULT_RULES: Tuple[RegressionRule, ...] = (
     RegressionRule("flops", threshold_pct=10.0),
     RegressionRule("bytes_accessed", threshold_pct=15.0, min_abs=1 << 20),
@@ -246,7 +262,7 @@ DEFAULT_RULES: Tuple[RegressionRule, ...] = (
     RegressionRule("seconds", kind="compile", threshold_pct=50.0, min_abs=1.0),
     RegressionRule("seconds", kind="phase", threshold_pct=25.0, min_abs=0.5),
 ) + (QUALITY_RULES + COMM_RULES + TIMING_RULES + FAULT_RULES + SEAM_RULES
-     + SLO_RULES + SEGMENT_RULES + SIGNAL_RULES)
+     + SLO_RULES + SEGMENT_RULES + SIGNAL_RULES + INCIDENT_RULES)
 
 
 def split_runs(events: Iterable[Dict[str, Any]]) -> List[List[Dict[str, Any]]]:
@@ -308,6 +324,14 @@ def extract_run(events: Sequence[Dict[str, Any]],
         # per label (plus per-tenant demand lanes and the fleet_series
         # store summary), gated by SIGNAL_RULES
         "signals": {},
+        # incident section (ISSUE 18): capture counts per trigger kind
+        # from `incident` ledger events, gated by INCIDENT_RULES (any
+        # increase regresses). The overall "incident" label is SEEDED at
+        # zero — rules only compare labels both runs share, so a healthy
+        # baseline (zero bundles) must still hold the label for a chaos
+        # run's first bundle to regress against it.
+        "incidents": {"incident": {"count": 0.0, "suppressed": 0.0,
+                                   "events": 0.0}},
     }
     seg_samples: Dict[str, List[float]] = {}
     for e in events:
@@ -518,6 +542,21 @@ def extract_run(events: Sequence[Dict[str, Any]],
                 elif isinstance(v, (int, float)):
                     vals[k] = float(v)
             rec["slo"][name] = vals
+        elif kind == "incident":
+            # capture counts accumulate over the run, overall AND per
+            # trigger kind — INCIDENT_RULES then flags any label that
+            # grew, so "more breaker bundles" and "first-ever crash"
+            # each get their own verdict line
+            trig = e.get("trigger") or "(unknown)"
+            for label in ("incident", f"incident:{trig}"):
+                m = rec["incidents"].setdefault(
+                    label, {"count": 0.0, "suppressed": 0.0, "events": 0.0})
+                m["count"] += 1.0
+                try:
+                    m["suppressed"] += float(e.get("suppressed") or 0.0)
+                    m["events"] += float(e.get("events") or 0.0)
+                except (TypeError, ValueError):
+                    pass
     for seg, durations in sorted(seg_samples.items()):
         rec["segments"][seg] = {
             "count": float(len(durations)),
@@ -559,9 +598,9 @@ def _rule_values(record: Dict[str, Any], rule: RegressionRule) -> Dict[str, floa
     elif rule.kind == "divergence":
         out = {k: float(v) for k, v in record.get("divergence", {}).items()}
     elif rule.kind in ("timing", "trace", "reliability", "stream", "slo",
-                       "segment", "signal"):
-        section = {"segment": "segments", "signal": "signals"}.get(
-            rule.kind, rule.kind)
+                       "segment", "signal", "incident"):
+        section = {"segment": "segments", "signal": "signals",
+                   "incident": "incidents"}.get(rule.kind, rule.kind)
         for label, m in record.get(section, {}).items():
             if rule.metric in m:
                 out[label] = float(m[rule.metric])

@@ -180,6 +180,12 @@ class RunLedger:
             self._bytes = 0
         self._rotations = 0
         self._lock = threading.Lock()
+        # optional flight-recorder tee (obs/flight.py, ISSUE 18): when an
+        # IncidentManager attaches a FlightRecorder here, every event
+        # record is ALSO appended to its bounded ring — one deque append;
+        # with flight=None (the default) the extra cost is one attribute
+        # check and the written stream is bit-exact either way.
+        self.flight: Optional[Any] = None
         self._t0 = time.perf_counter()
         self._closed = False
         self._activated = False
@@ -228,6 +234,9 @@ class RunLedger:
         so a field may itself be named ``kind`` (the ``fault`` events)."""
         rec = {"event": kind, "t": round(time.perf_counter() - self._t0, 4)}
         rec.update(fields)
+        flight = self.flight
+        if flight is not None:
+            flight.record(rec)  # bounded ring append; never raises
         try:
             line = json.dumps(rec, default=str)
         except (TypeError, ValueError):

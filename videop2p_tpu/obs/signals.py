@@ -109,6 +109,11 @@ FLEET_SIGNALS_FIELDS = (
     "replicas_up",
     "replicas_total",
     "tenants",
+    # reservoir trace-id exemplars (ISSUE 18 satellite): per program,
+    # the scraped p99_trace_id/max_trace_id — an alert NAMES the traces
+    # that burned the budget even outside an incident bundle. Always
+    # present; {} when no target exposes exemplars (tracing off).
+    "exemplars",
     "scale_advice",
     "reasons",
 )
@@ -203,6 +208,33 @@ class SignalEngine:
                                               "shrink": 0}
         self._lat_ewma = _Ewma(ewma_alpha, ewma_tolerance, floor=0.005)
         self._hit_ewma = _Ewma(ewma_alpha, ewma_tolerance, floor=0.05)
+        # latest scraped per-program trace-id exemplars (ISSUE 18
+        # satellite): the collector pushes them from each target's
+        # /metrics `programs` reservoirs; the tsdb stays scalar-only
+        self._exemplars: Dict[str, Dict[str, Optional[str]]] = {}
+
+    def set_exemplars(
+            self, exemplars: Dict[str, Dict[str, Optional[str]]]) -> None:
+        """Replace the current per-program ``{p99_trace_id,
+        max_trace_id}`` exemplar map (best-effort side channel — trace-id
+        strings don't fit the scalar tsdb)."""
+        self._exemplars = {
+            str(k): {"p99_trace_id": (v or {}).get("p99_trace_id"),
+                     "max_trace_id": (v or {}).get("max_trace_id")}
+            for k, v in (exemplars or {}).items()
+        }
+
+    def _exemplar_hint(self) -> Optional[str]:
+        """One offending trace id for the advice reasons — the dispatch
+        program's p99 exemplar when present, else any program's."""
+        items = sorted(self._exemplars.items(),
+                       key=lambda kv: (0 if "dispatch" in kv[0] else 1,
+                                       kv[0]))
+        for program, ex in items:
+            tid = ex.get("p99_trace_id") or ex.get("max_trace_id")
+            if tid:
+                return f"{program} p99_trace={tid}"
+        return None
 
     # ---- pieces ----------------------------------------------------------
 
@@ -357,13 +389,17 @@ class SignalEngine:
 
         # ---- scale advice ------------------------------------------------
         reasons: List[str] = []
+        exemplar_hint = self._exemplar_hint()
         if burn_alert:
             reasons.append(
                 f"slo-burn fast={burn_fast:.2f} slow={burn_slow:.2f} "
-                f"(threshold {self.burn_threshold:g})")
+                f"(threshold {self.burn_threshold:g})"
+                + (f"; exemplar {exemplar_hint}" if exemplar_hint else ""))
         if saturation > self.saturation_threshold:
-            reasons.append(f"saturation {saturation:.2f} > "
-                           f"{self.saturation_threshold:g}")
+            reasons.append(
+                f"saturation {saturation:.2f} > "
+                f"{self.saturation_threshold:g}"
+                + (f"; exemplar {exemplar_hint}" if exemplar_hint else ""))
         if queue_slope > self.queue_slope_threshold:
             qmeans = [self.tsdb.mean(S_QUEUE_DEPTH, t, self.slow_window_s, ls)
                       for ls in self.tsdb.labelsets(S_QUEUE_DEPTH)]
@@ -423,6 +459,8 @@ class SignalEngine:
             "replicas_up": replicas_up,
             "replicas_total": replicas_total,
             "tenants": tenants,
+            "exemplars": {k: dict(v) for k, v in
+                          sorted(self._exemplars.items())},
             "scale_advice": advice,
             "reasons": reasons,
         }

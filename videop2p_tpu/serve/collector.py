@@ -219,6 +219,7 @@ class FleetCollector:
         window_scale: float = 1.0,
         signal_kwargs: Optional[Dict[str, Any]] = None,
         clock: Callable[[], float] = time.perf_counter,
+        incidents: Any = None,
     ):
         if fmt not in ("json", "prometheus"):
             raise ValueError(f"fmt must be 'json' or 'prometheus', got {fmt!r}")
@@ -239,6 +240,23 @@ class FleetCollector:
         # at end-of-run, so it drains this buffer into `fleet_signals`
         # events instead of passing a live ledger
         self.history: deque = deque(maxlen=4096)
+        # per-program reservoir exemplars scraped from target /metrics
+        # (`programs` summaries carry p99_trace_id/max_trace_id); pushed
+        # into the SignalEngine before every evaluate so burn alerts can
+        # NAME a trace, and served to the IncidentManager for bundles
+        self._exemplars: Dict[str, Dict[str, Any]] = {}
+        self.incidents = incidents
+        if incidents is not None:
+            # a shared manager: give it our tsdb (bundles snapshot the
+            # scrape window) and our targets (bundles re-probe the fleet)
+            if getattr(incidents, "tsdb", None) is None:
+                incidents.tsdb = self.tsdb
+            for tgt in self.targets:
+                incidents.register_target(
+                    f"scrape:{tgt.name}",
+                    (lambda c: lambda: {"healthz": c.healthz(),
+                                        "metrics": c.metrics()})(tgt.client))
+            incidents.register_exemplars(lambda: dict(self._exemplars))
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -278,8 +296,9 @@ class FleetCollector:
                 ingest_prom_samples(self.tsdb, target.name, t,
                                     parse_prometheus(text)["samples"])
             else:
-                ingest_engine_metrics(self.tsdb, target.name, t,
-                                      target.client.metrics())
+                metrics = target.client.metrics()
+                ingest_engine_metrics(self.tsdb, target.name, t, metrics)
+                self._cache_exemplars(metrics)
         except Exception:  # noqa: BLE001 — half-up: healthz ok, metrics not
             target.errors += 1
             self.scrape_errors += 1
@@ -310,11 +329,34 @@ class FleetCollector:
         self.scrapes += 1
         return ok
 
+    def _cache_exemplars(self, metrics: Dict[str, Any]) -> None:
+        """Keep the freshest per-program trace-id exemplars seen on any
+        target's ``programs`` reservoir summaries (JSON scrape only — the
+        Prometheus exposition carries no trace ids)."""
+        try:
+            programs = metrics.get("programs") or {}
+            for program, summary in programs.items():
+                p99 = summary.get("p99_trace_id")
+                mx = summary.get("max_trace_id")
+                if p99 is not None or mx is not None:
+                    self._exemplars[str(program)] = {
+                        "p99_trace_id": p99, "max_trace_id": mx}
+        except Exception:  # noqa: BLE001 — exemplars are best-effort
+            pass
+
     def evaluate(self, now: Optional[float] = None) -> Dict[str, Any]:
-        """One signal pass (emits ``fleet_signals`` into the ledger)."""
+        """One signal pass (emits ``fleet_signals`` into the ledger).
+        Burn alerts also fire the incident trigger when a manager is
+        attached — the page and the evidence capture are one motion."""
         t = self.clock() if now is None else float(now)
+        self.signals.set_exemplars(self._exemplars)
         rec = self.signals.evaluate(t, ledger=self.ledger)
         self.history.append(rec)
+        if rec.get("burn_alert") and self.incidents is not None:
+            self.incidents.trigger(
+                "burn_alert",
+                detail="; ".join(str(r) for r in (rec.get("reasons") or [])),
+                scale_advice=rec.get("scale_advice"))
         return rec
 
     # ---- the loop --------------------------------------------------------

@@ -61,8 +61,9 @@ import queue
 import threading
 import time
 import uuid
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -284,6 +285,11 @@ class EditEngine:
         # is pinned bit-exact with zero added dispatches.
         tracing: bool = False,
         slo: bool = False,
+        # incident plane (ISSUE 18 — obs/incident.py): a bundle-root dir
+        # string (the engine builds its own IncidentManager with crash
+        # hooks) or a shared IncidentManager instance (an in-process
+        # fleet debounces across replicas). None = off, bit-exact.
+        incidents: Any = None,
     ):
         from videop2p_tpu.cli.common import make_run_ledger
 
@@ -327,7 +333,11 @@ class EditEngine:
         self.tracer = Tracer(self.ledger, enabled=tracing)
         self._tracing = self.tracer.enabled
         self._slo = bool(slo)
-        self.fault_log: List[Dict[str, Any]] = []
+        # most-recent-wins ring (ISSUE 18 satellite): a long chaos run
+        # must keep the LAST 256 fault/breaker entries — the ones an
+        # incident needs — not the first 256. deque(maxlen=...) evicts
+        # the oldest on append; consumers iterate it like the old list.
+        self.fault_log: Deque[Dict[str, Any]] = deque(maxlen=_FAULT_LOG_MAX)
         self.counters: Dict[str, int] = {
             "shed": 0, "rejected_unavailable": 0, "retries": 0,
             "faults_injected": 0, "rehydrations": 0, "fresh_inversions": 0,
@@ -361,6 +371,31 @@ class EditEngine:
         self.store = InversionStore(store_budget_bytes, persist_dir=persist_dir,
                                     faults=self.faults)
         self._spec_fp = self.spec.fingerprint()
+        # incident plane (ISSUE 18): tee this ledger into the manager's
+        # flight ring, register this engine as a /healthz+/metrics
+        # snapshot target and its reservoirs as the trace-id exemplar
+        # source. A shared manager (in-process fleet) is used as-is and
+        # NOT closed by this engine; a dir string builds an owned one.
+        self.incidents = None
+        self._own_incidents = False
+        if incidents is not None:
+            from videop2p_tpu.obs.incident import IncidentManager
+
+            if isinstance(incidents, IncidentManager):
+                self.incidents = incidents
+            else:
+                self.incidents = IncidentManager(str(incidents),
+                                                 crash_hooks=True)
+                self._own_incidents = True
+            self.incidents.attach_ledger(self.ledger)
+            self.incidents.note_fingerprint(
+                f"engine:{self.ledger.run_id}", self._spec_fp)
+            self.incidents.register_target(
+                f"engine:{self.ledger.run_id}",
+                lambda: {"healthz": self.health_record(),
+                         "metrics": self.metrics()})
+            self.incidents.register_exemplars(
+                self.ledger.execute_timing_summary)
         self._requests: Dict[str, Dict[str, Any]] = {}
         self._videos: Dict[str, np.ndarray] = {}
         self._req_lock = threading.Lock()
@@ -717,6 +752,11 @@ class EditEngine:
                 pass
         self.ledger.event("serve_health", **health)
         self.ledger.event("serve_shutdown", requests=len(self._requests))
+        if self.incidents is not None and self._own_incidents:
+            try:
+                self.incidents.close()  # restores the crash hooks
+            except Exception:  # noqa: BLE001 — obs never blocks shutdown
+                pass
         self.ledger.close()
 
     def __enter__(self) -> "EditEngine":
@@ -751,8 +791,7 @@ class EditEngine:
                     "store_corrupt"):
             self._count("faults_injected")
         entry = {"event": "fault", "kind": kind, "detail": detail}
-        if len(self.fault_log) < _FAULT_LOG_MAX:
-            self.fault_log.append(entry)
+        self.fault_log.append(entry)  # ring: oldest evicts, tail survives
         self.ledger.fault(kind, detail=detail)
 
     def _on_breaker(self, state_from: str, state_to: str, *,
@@ -760,11 +799,18 @@ class EditEngine:
         entry = {"event": "breaker", "state_from": state_from,
                  "state_to": state_to,
                  "consecutive_failures": consecutive_failures, "trips": trips}
-        if len(self.fault_log) < _FAULT_LOG_MAX:
-            self.fault_log.append(entry)
+        self.fault_log.append(entry)  # ring: oldest evicts, tail survives
         self.ledger.breaker(state_from, state_to,
                             consecutive_failures=consecutive_failures,
                             trips=trips)
+        if state_to == "open" and self.incidents is not None:
+            # the breaker declaring the backend unhealthy IS the incident
+            # — capture the flight ring while the evidence is still hot
+            self.incidents.trigger(
+                "breaker_open",
+                detail=(f"{state_from}->open after {consecutive_failures} "
+                        f"consecutive dispatch failures (trip {trips})"),
+                consecutive_failures=consecutive_failures, trips=trips)
 
     # ---- worker ----------------------------------------------------------
 
@@ -1154,6 +1200,11 @@ class EditEngine:
                 # the budget is burned — never retried; the breaker counts
                 # it (a wedged device looks exactly like this)
                 self.breaker.record_failure()
+                if self.incidents is not None:
+                    self.incidents.trigger(
+                        "deadline_exceeded",
+                        detail=f"dispatch watchdog: {e}",
+                        batch_size=len(live))
                 for p in live:
                     self._fail_status(p.rid, "deadline_exceeded", str(e))
                 return

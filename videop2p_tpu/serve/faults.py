@@ -342,25 +342,39 @@ class CircuitBreaker:
         self._opened_at = 0.0
         self._lock = threading.Lock()
 
-    def _transition(self, new_state: str) -> None:
+    def _transition(self, new_state: str):
+        """Caller holds the lock. Returns the ``on_transition`` thunk to
+        run AFTER the lock is released — a callback that re-enters
+        breaker state (the incident plane's ``/metrics`` target probe
+        snapshots it mid-capture) must not deadlock on this lock."""
         old, self._state = self._state, new_state
-        if old != new_state and self.on_transition is not None:
+        if old == new_state or self.on_transition is None:
+            return None
+        failures, trips = self.consecutive_failures, self.trips
+
+        def fire():
             try:
                 self.on_transition(old, new_state,
-                                   consecutive_failures=self.consecutive_failures,
-                                   trips=self.trips)
+                                   consecutive_failures=failures,
+                                   trips=trips)
             except Exception:  # noqa: BLE001 — observability never breaks the breaker
                 pass
+
+        return fire
 
     @property
     def state(self) -> str:
         """Current state; an elapsed open window lazily becomes
         half-open (the probe admission)."""
+        fire = None
         with self._lock:
             if (self._state == "open"
                     and time.perf_counter() - self._opened_at >= self.open_s):
-                self._transition("half_open")
-            return self._state
+                fire = self._transition("half_open")
+            state = self._state
+        if fire is not None:
+            fire()
+        return state
 
     def allow(self) -> bool:
         """May a new request be admitted right now?"""
@@ -376,6 +390,7 @@ class CircuitBreaker:
 
     def record_failure(self) -> None:
         self.state  # noqa: B018 — resolve a lapsed open window into half-open first
+        fire = None
         with self._lock:
             self.consecutive_failures += 1
             if self._state == "half_open" or (
@@ -384,13 +399,18 @@ class CircuitBreaker:
             ):
                 self.trips += 1
                 self._opened_at = time.perf_counter()
-                self._transition("open")
+                fire = self._transition("open")
+        if fire is not None:
+            fire()
 
     def record_success(self) -> None:
+        fire = None
         with self._lock:
             self.consecutive_failures = 0
             if self._state != "closed":
-                self._transition("closed")
+                fire = self._transition("closed")
+        if fire is not None:
+            fire()
 
     def snapshot(self) -> Dict[str, Any]:
         """The ``/metrics`` and ``/healthz`` breaker section."""

@@ -159,6 +159,7 @@ class Router:
         ledger: Any = None,
         ledger_path: Optional[str] = None,
         tracing: bool = False,
+        incidents: Any = None,
     ):
         urls = [str(u) for u in replica_urls if str(u).strip()]
         if not urls:
@@ -194,6 +195,29 @@ class Router:
         }
         self.started = time.perf_counter()
         self._closed = False
+        # incident plane (ISSUE 18): a dir string means the router OWNS a
+        # manager (crash hooks installed, closed with the router); an
+        # IncidentManager instance means fleet-shared debounce — the
+        # router only contributes its ledger tee + replica probe targets
+        self.incidents = None
+        self._own_incidents = False
+        if incidents is not None:
+            from videop2p_tpu.obs.incident import IncidentManager
+
+            if isinstance(incidents, IncidentManager):
+                self.incidents = incidents
+            else:
+                self.incidents = IncidentManager(str(incidents),
+                                                 crash_hooks=True)
+                self._own_incidents = True
+            if self.ledger is not None:
+                self.incidents.attach_ledger(self.ledger)
+            for v in self.views:
+                self.incidents.register_target(
+                    f"router:{v.name}",
+                    (lambda pc: lambda: {"healthz": pc.healthz(),
+                                         "metrics": pc.metrics()})(
+                        v.probe_client))
 
     # ---- placement -------------------------------------------------------
 
@@ -440,6 +464,12 @@ class Router:
         self._closed = True
         if self.ledger is not None:
             self.ledger.event("router_health", **self.health_record())
+        if self.incidents is not None and self._own_incidents:
+            try:
+                self.incidents.close()
+            except Exception:  # noqa: BLE001
+                pass
+        if self.ledger is not None:
             self.ledger.close()
 
     def __enter__(self) -> "Router":
