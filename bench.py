@@ -995,6 +995,64 @@ def record_per_call_cost(rec, *, timeout_s=None, ks=PER_CALL_COST_KS) -> None:
     rec.record("per_call_cost_backend", "cpu-static")
 
 
+# the cost-plane evidence (ISSUE 19): the bench round's program analyses
+# run through the SAME CostModel the serving engine prices dispatches
+# with, so every round — including backend-down rounds, where the
+# analyses come from the cpu_cost_capture subprocess — records the cost
+# plane's static inputs and (when the backend was up) the achieved
+# flops/s against them. Schema pinned by tests/test_bench_guard.py.
+BENCH_COST_FIELDS = (
+    "program", "flops", "argument_bytes", "peak_hbm_bytes",
+    "measured_s", "achieved_flops_per_s",
+)
+
+
+def bench_cost_records(analyses, measured=None):
+    """Per-program static cost vectors through
+    :class:`videop2p_tpu.obs.cost.CostModel` (the serving engine's
+    pricing model), joined with this round's measured headline seconds
+    when the backend executed them. ``measured`` absent/None → the
+    static columns alone (the backend-down shape). Pure + CPU-tested;
+    every record carries exactly ``BENCH_COST_FIELDS``."""
+    from videop2p_tpu.obs.cost import CostModel
+
+    model = CostModel()
+    rows = []
+    for program in sorted(analyses or {}):
+        a = analyses[program]
+        if not isinstance(a, dict):
+            continue
+        model.observe_program(str(program), a)
+        st = model.static_cost(str(program))
+        if not st:
+            continue
+        s = (measured or {}).get(program)
+        s = float(s) if isinstance(s, (int, float)) and s > 0 else None
+        flops = st.get("flops")
+        rows.append({
+            "program": str(program),
+            "flops": flops,
+            "argument_bytes": st.get("argument_bytes"),
+            "peak_hbm_bytes": st.get("peak_hbm_bytes"),
+            "measured_s": None if s is None else round(s, 3),
+            "achieved_flops_per_s": (
+                round(float(flops) / s, 3) if s and flops else None),
+        })
+    return rows
+
+
+def record_bench_costs(rec, analyses, *, measured=None,
+                       backend="cpu-static") -> None:
+    """Persist the cost-plane evidence (``cost_model``) — every round,
+    backend up or down. Best-effort: no analyses records nothing rather
+    than killing the round."""
+    records = bench_cost_records(analyses, measured)
+    if not records:
+        return
+    rec.record("cost_model", records)
+    rec.record("cost_model_backend", backend)
+
+
 def build_fast_edit_working_point(*, num_frames: int = 8, num_steps: int = 50,
                                   frame_attention: str = "auto",
                                   group_norm: str = "auto",
@@ -1590,6 +1648,9 @@ def record_cpu_only_evidence(repo_dir=None) -> None:
     # flops and store bytes per window — reuses the capture above (it
     # already holds e2e_cached, the per-window program)
     record_streaming_scaling(rec, analyses=analyses)
+    # the cost-plane evidence (ISSUE 19): the same capture through the
+    # serving engine's CostModel — backend down, so static columns only
+    record_bench_costs(rec, analyses)
     # the per-call cost evidence (ISSUE 15): quantized weight-footprint
     # and reuse flop-fraction from loop-free unit programs, plus the
     # quant/reuse variant rows on the executed tiny frontier below
@@ -1830,6 +1891,15 @@ def main() -> None:
             )
             if comm_records:
                 rec.record("comm_analysis", comm_records)
+            # cost-plane evidence (ISSUE 19): static pricing + this
+            # round's measured headline seconds → achieved flops/s
+            record_bench_costs(
+                rec, analyses,
+                measured={"invert_captured": r_inv.seconds,
+                          "edit_cached": r_edit.seconds,
+                          "e2e_cached": r_e2e.seconds},
+                backend=jax.devices()[0].platform,
+            )
         except Exception as e:  # noqa: BLE001 — evidence, never the record
             print(f"[bench] program analysis failed: {e}", file=sys.stderr,
                   flush=True)

@@ -517,7 +517,10 @@ def test_report_and_obs_import_only_stdlib_numpy_jax():
              os.path.join(_REPO, "tools", "fleet_dash.py"),
              # ISSUE 18 pin: the post-mortem renderer must open a bundle
              # anywhere — it ships in bug reports, not deployments
-             os.path.join(_REPO, "tools", "incident_report.py")]
+             os.path.join(_REPO, "tools", "incident_report.py"),
+             # ISSUE 19 pin: the showback report ships in chargeback
+             # emails — stdlib+numpy SVG bars, no plotting stack
+             os.path.join(_REPO, "tools", "cost_report.py")]
     obs_dir = os.path.join(_REPO, "videop2p_tpu", "obs")
     obs_files = sorted(f for f in os.listdir(obs_dir) if f.endswith(".py"))
     # ISSUE 6 pins: the time-domain modules are IN the guarded set — the
@@ -532,10 +535,13 @@ def test_report_and_obs_import_only_stdlib_numpy_jax():
     # ISSUE 18 pins: the incident plane joins — the flight recorder is
     # on the ledger hot path and the capture manager runs in every
     # serving process, so both stay stdlib(+numpy via the sidecar)
+    # ISSUE 19 pins: the cost plane joins — the attribution model runs
+    # inside every engine, so it stays stdlib+numpy
     assert {"timing.py", "trace.py",
             "spans.py", "slo.py", "prom.py",
             "tsdb.py", "signals.py",
-            "flight.py", "incident.py"} <= set(obs_files)
+            "flight.py", "incident.py",
+            "cost.py"} <= set(obs_files)
     files += [os.path.join(obs_dir, f) for f in obs_files]
     # ISSUE 7 pins: the serving subsystem is IN the guarded set — the
     # HTTP layer stays stdlib http.server/urllib (no flask/requests), and
@@ -1002,8 +1008,75 @@ def test_router_and_tenant_ledger_event_schema(tmp_path):
     # per-tenant records carry exactly the pinned keys
     from videop2p_tpu.serve.engine import EditEngine
 
-    assert set(EditEngine._TENANT_COUNTER_KEYS) | {"error_rate", "shed_rate"} \
-        == set(SERVE_TENANT_FIELDS)
+    # ISSUE 19: the chargeback fields ride the same records — counters
+    # plus rates plus the measured cost-plane columns cover the pin
+    assert set(EditEngine._TENANT_COUNTER_KEYS) | {
+        "error_rate", "shed_rate", "device_seconds",
+        "saved_device_seconds"} == set(SERVE_TENANT_FIELDS)
+
+
+def test_cost_plane_schema_pins_and_extraction(tmp_path):
+    """Schema pin (ISSUE 19): the cost plane's field tuples are pinned
+    byte-for-byte — terminal request ``cost`` vectors, the
+    ``cost_attribution`` chargeback rows, the engine capacity roll-up —
+    COST_RULES ride in DEFAULT_RULES (kind "cost", teeth for
+    cost_per_request/utilization/padding-waste regressions), and
+    obs/history.py flattens attribution rows into the ``cost`` section
+    under the serve / serve:tenant:X / serve:program:Y label scheme."""
+    from videop2p_tpu.obs import RunLedger, read_ledger
+    from videop2p_tpu.obs.cost import (
+        CAPACITY_FIELDS,
+        COST_ATTRIBUTION_FIELDS,
+        REQUEST_COST_FIELDS,
+    )
+    from videop2p_tpu.obs.history import (
+        COST_RULES,
+        DEFAULT_RULES,
+        extract_run,
+        split_runs,
+    )
+
+    assert REQUEST_COST_FIELDS == (
+        "program", "device_seconds", "flops", "hbm_byte_seconds",
+        "queue_seconds", "padding_share", "saved_device_seconds",
+        "saved_flops")
+    assert COST_ATTRIBUTION_FIELDS == (
+        "scope", "name", "requests", "store_hits", "device_seconds",
+        "flops", "hbm_byte_seconds", "queue_seconds",
+        "saved_device_seconds", "saved_flops", "cost_per_request_s")
+    assert CAPACITY_FIELDS == (
+        "uptime_s", "busy_seconds", "attributed_seconds",
+        "padding_seconds", "idle_seconds", "busy_fraction",
+        "idle_fraction", "padding_waste", "occupancy", "dispatches",
+        "real_slots", "padded_slots", "requests_costed",
+        "cost_per_request_s", "conservation_residual_s")
+    # the rules gate by default, all kind "cost", utilization pointing
+    # the economic way (busy_fraction regresses by DECREASING)
+    assert set(COST_RULES) <= set(DEFAULT_RULES)
+    assert all(r.kind == "cost" for r in COST_RULES)
+    by_metric = {r.metric: r for r in COST_RULES}
+    assert set(by_metric) == {"cost_per_request_s", "busy_fraction",
+                              "padding_waste", "idle_fraction"}
+    assert by_metric["busy_fraction"].direction == "decrease"
+    # extraction: engine/tenant/program rows land under the documented
+    # label scheme; a pre-cost-plane ledger extracts an empty section
+    path = str(tmp_path / "ledger.jsonl")
+    with RunLedger(path) as led:
+        led.event("cost_attribution", label="serve", scope="engine",
+                  name="serve", busy_fraction=0.5, cost_per_request_s=0.2)
+        led.event("cost_attribution", label="serve", scope="tenant",
+                  name="A", requests=3, device_seconds=0.6)
+        led.event("cost_attribution", label="serve", scope="program",
+                  name="serve_edit", requests=3, flops=9.0)
+    rec = extract_run(split_runs(read_ledger(path))[-1])
+    assert set(rec["cost"]) == {"serve", "serve:tenant:A",
+                                "serve:program:serve_edit"}
+    assert rec["cost"]["serve"]["busy_fraction"] == 0.5
+    assert rec["cost"]["serve:tenant:A"]["device_seconds"] == 0.6
+    empty = str(tmp_path / "old.jsonl")
+    with RunLedger(empty) as led:
+        led.event("serve_health", requests=1)
+    assert extract_run(split_runs(read_ledger(empty))[-1])["cost"] == {}
 
 
 def test_stream_health_ledger_event_schema_and_seam_rules(tmp_path):
@@ -1388,6 +1461,42 @@ def test_per_call_cost_record_schema(bench):
     assert all(set(r) == set(bench.PER_CALL_COST_FIELDS) for r in partial)
     assert bench.per_call_cost_records({}) == []
     assert bench.per_call_cost_records(None) == []
+
+
+def test_bench_cost_records_schema(bench):
+    """ISSUE 19: bench's cost rows are schema-pinned — every analyzed
+    program lands with exactly BENCH_COST_FIELDS, measured seconds price
+    an achieved flops/s, static-only rows (backend down: no timings)
+    carry None for both measured columns, and malformed/empty analyses
+    yield []."""
+    assert bench.BENCH_COST_FIELDS == (
+        "program", "flops", "argument_bytes", "peak_hbm_bytes",
+        "measured_s", "achieved_flops_per_s")
+    analyses = {
+        "invert_captured": {"flops": 1000.0, "argument_bytes": 64,
+                            "temp_bytes": 8, "peak_hbm_bytes": 128,
+                            "bytes_accessed": 256},
+        "edit_cached": {"flops": 500.0, "argument_bytes": 32,
+                        "peak_hbm_bytes": 100, "bytes_accessed": 90},
+        "bogus": "not-a-dict",   # ignored, never raises
+    }
+    rows = bench.bench_cost_records(analyses,
+                                    {"invert_captured": 2.0,
+                                     "edit_cached": 0})   # 0 s: unusable
+    assert [r["program"] for r in rows] == ["edit_cached",
+                                            "invert_captured"]
+    for r in rows:
+        assert set(r) == set(bench.BENCH_COST_FIELDS), r
+    by = {r["program"]: r for r in rows}
+    assert by["invert_captured"]["measured_s"] == 2.0
+    assert by["invert_captured"]["achieved_flops_per_s"] == 500.0
+    assert by["edit_cached"]["measured_s"] is None
+    assert by["edit_cached"]["achieved_flops_per_s"] is None
+    # static-only path (record_cpu_only_evidence: backend down)
+    static = bench.bench_cost_records(analyses)
+    assert all(r["measured_s"] is None for r in static)
+    assert bench.bench_cost_records({}) == []
+    assert bench.bench_cost_records(None) == []
 
 
 @pytest.mark.slow

@@ -698,6 +698,8 @@ def _fleet_loadgen_run(programs, root, *, faults=None, seed=71):
                 events += [dict(e) for e in r.engine.fault_log]
                 events.append({"event": "serve_health", "label": r.name,
                                **r.engine.health_record()})
+                events += [{"event": "cost_attribution", "label": r.name,
+                            **row} for row in r.engine.cost_records()]
             events.append({"event": "router_health",
                            **router.health_record()})
             collector.stop(final_evaluate=True)
@@ -802,6 +804,16 @@ def test_fleet_collector_acceptance_healthy_vs_chaos(programs, tmp_path):
     assert h_record["signals"]["targets"] == 3
     assert h_record["signals"]["scrape_errors"] == 0
     assert h_events[-1]["replicas_up"] == 2
+    # ISSUE 19: the scraped cost plane PRICED the advice — the roll-up
+    # carries measured utilization and at least one evaluation cites an
+    # economic reason (hold/shrink annotations or a priced grow)
+    assert h_events[-1]["utilization"] is not None
+    assert any(("economics" in r) or ("shrink-is-cheap" in r)
+               for e in h_events for r in e["reasons"])
+    # the replicas' chargeback rows rode collect_extra into the ledger
+    h_costs = [e for e in read_ledger(h_ledger)
+               if e.get("event") == "cost_attribution"]
+    assert {e["scope"] for e in h_costs} >= {"engine", "tenant"}
 
     # chaos: replica 0's doomed dispatches burned BOTH windows at least
     # once and the advice flipped to grow while degraded

@@ -636,6 +636,73 @@ def test_engine_bad_request_fails_cleanly(engine):
     assert rec2["status"] == "done"
 
 
+def test_engine_cost_vector_amortization_and_conservation(engine):
+    """ISSUE 19 acceptance pins. (a) Every terminal record carries a
+    ``cost`` vector with exactly REQUEST_COST_FIELDS plus the dispatch's
+    ``batch_occupancy``; (b) amortization: a cold request's attributed
+    device-seconds INCLUDE its fresh inversion, so the identical repeat
+    (store hit) is strictly cheaper AND records the avoided spend as
+    ``saved_device_seconds > 0``; (c) conservation: attributed + padding
+    device-seconds equal worker busy seconds (residual ~0), idle is
+    explicit, and the per-tenant ledger sums back to the attributed
+    total — nothing is silently dropped."""
+    from videop2p_tpu.obs.cost import (
+        CAPACITY_FIELDS,
+        COST_ATTRIBUTION_FIELDS,
+        REQUEST_COST_FIELDS,
+    )
+
+    tiger = dict(image_path="data/tiger", prompt="a tiger is resting",
+                 prompts=["a tiger is resting", "a origami tiger is resting"],
+                 save_name="tiger", tenant="chargeback")
+    cold = engine.result(engine.submit(_rabbit_request(**tiger)),
+                         wait_s=300.0)
+    hit = engine.result(engine.submit(_rabbit_request(**tiger)),
+                        wait_s=300.0)
+    assert cold["status"] == "done" and cold["store_hit"] is False
+    assert hit["status"] == "done" and hit["store_hit"] is True
+    for rec in (cold, hit):
+        assert set(rec["cost"]) == set(REQUEST_COST_FIELDS)
+        occ = rec["batch_occupancy"]
+        assert 1 <= occ["real"] <= occ["padded"]
+        assert rec["cost"]["device_seconds"] > 0.0
+    # the cold request paid for its inversion; the hit avoided it
+    assert cold["cost"]["saved_device_seconds"] == 0.0
+    assert hit["cost"]["saved_device_seconds"] > 0.0
+    assert hit["cost"]["device_seconds"] < cold["cost"]["device_seconds"]
+    # /metrics capacity: the conservation invariant, with idle explicit
+    cap = engine.metrics()["capacity"]
+    assert set(cap) == set(CAPACITY_FIELDS)
+    assert cap["busy_seconds"] == pytest.approx(
+        cap["attributed_seconds"] + cap["padding_seconds"], abs=1e-5)
+    assert abs(cap["conservation_residual_s"]) < 1e-5
+    assert cap["idle_seconds"] >= 0.0 and cap["dispatches"] > 0
+    assert 0.0 < cap["occupancy"] <= 1.0
+    # the chargeback rows: engine scope carries the capacity record,
+    # tenant rows sum back to the attributed total (every dispatched
+    # request accounted — rounding is the only slack)
+    rows = engine.cost_records()
+    by_scope = {}
+    for r in rows:
+        by_scope.setdefault(r["scope"], []).append(r)
+    assert set(by_scope["engine"][0]) >= set(CAPACITY_FIELDS) | {
+        "scope", "name"}
+    tenant_rows = by_scope["tenant"]
+    for r in tenant_rows:
+        assert set(r) == set(COST_ATTRIBUTION_FIELDS)
+    assert "chargeback" in {r["name"] for r in tenant_rows}
+    assert sum(r["device_seconds"] for r in tenant_rows) == pytest.approx(
+        cap["attributed_seconds"], abs=0.01)
+    # per-program rows carry the static-model join (serve_invert priced
+    # the cold inversion as a singleton dispatch)
+    assert "serve_invert" in {r["name"] for r in by_scope["program"]}
+    # the health surface rides the same books
+    health = engine.health_record()
+    assert health["busy_fraction"] == pytest.approx(cap["busy_fraction"],
+                                                    abs=0.05)
+    assert "padding_waste" in health
+
+
 def test_http_roundtrip_and_metrics(engine):
     from videop2p_tpu.serve.client import EngineClient, engine_available
     from videop2p_tpu.serve.http import make_server

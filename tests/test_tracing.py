@@ -193,15 +193,23 @@ def test_prometheus_golden_format():
         "warm": True,
         "spec": "abc123",                    # identity string: skipped
         "queue_depth": 2,
+        # ISSUE 19: the cost plane's capacity section rides the generic
+        # nested-dict render as videop2p_capacity_* gauges
+        "capacity": {"busy_fraction": 0.25, "padding_waste": 0.5},
         "compile": {"events": 4, "total_s": 1.25},
         "requests": {"done": 3, "error": 1},
-        "tenants": {"a": {"error_rate": 0.0, "requests": 2}},
+        "tenants": {"a": {"error_rate": 0.0, "requests": 2,
+                          "device_seconds": 1.5}},
         "replicas": {"r0": {"healthy": True, "requests": {"done": 3},
                             "nan_gauge": float("nan")}},
         "inf_gauge": float("inf"),
     }
     assert render_prometheus(metrics) == (
-        _hdr("videop2p_compile_events")
+        _hdr("videop2p_capacity_busy_fraction")
+        + "videop2p_capacity_busy_fraction 0.25\n"
+        + _hdr("videop2p_capacity_padding_waste")
+        + "videop2p_capacity_padding_waste 0.5\n"
+        + _hdr("videop2p_compile_events")
         + "videop2p_compile_events 4\n"
         + _hdr("videop2p_compile_total_s")
         + "videop2p_compile_total_s 1.25\n"
@@ -218,6 +226,8 @@ def test_prometheus_golden_format():
         + _hdr("videop2p_requests_total")
         + 'videop2p_requests_total{status="done"} 3\n'
         + 'videop2p_requests_total{status="error"} 1\n'
+        + _hdr("videop2p_tenant_device_seconds")
+        + 'videop2p_tenant_device_seconds{tenant="a"} 1.5\n'
         + _hdr("videop2p_tenant_error_rate")
         + 'videop2p_tenant_error_rate{tenant="a"} 0\n'
         + _hdr("videop2p_tenant_requests")

@@ -106,6 +106,9 @@ def measure(name, fn, reps: int = 8):
 
 
 def main():
+    if any(a in ("-h", "--help") for a in sys.argv[1:]):
+        print(__doc__.strip())
+        return 0
     reps = int(sys.argv[1]) if len(sys.argv) > 1 else 8
     print(f"shape: q=({B},{F},{H},{N},{D})  reps={reps}  "
           f"device={jax.devices()[0].device_kind}")

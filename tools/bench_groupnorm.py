@@ -88,6 +88,9 @@ def run_one(group_norm: str, steps: int):
 
 
 def main() -> None:
+    if any(a in ("-h", "--help") for a in sys.argv[1:]):
+        print(__doc__.strip())
+        return
     steps = int(sys.argv[1]) if len(sys.argv) > 1 else 50
     results = {}
     for impl in ("xla", "auto"):

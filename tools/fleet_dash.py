@@ -18,7 +18,11 @@ evaluations and the ``fleet_series`` tsdb snapshot a
     gap markers preserved — a dead replica's outage shows as a hole,
     never an interpolated line;
   * **per-tenant demand table** — submitted/served/shed rates and
-    device-seconds per lane from the last evaluation.
+    device-seconds per lane from the last evaluation;
+  * **cost panel (ISSUE 19)** — engine utilization/padding-waste and the
+    per-tenant attributed device-seconds from the run's
+    ``cost_attribution`` chargeback rows (full showback:
+    ``tools/cost_report.py``).
 
 Everything is inline (CSS + SVG, no external assets) — the output ships
 in a bug report. Tolerates signal-only ledgers (no snapshot event → no
@@ -148,6 +152,7 @@ def render_dash(events: Sequence[Dict[str, Any]],
     start = next((e for e in events if e.get("event") == "run_start"), {})
     sigs = [e for e in events if e.get("event") == "fleet_signals"]
     incidents = [e for e in events if e.get("event") == "incident"]
+    costs = [e for e in events if e.get("event") == "cost_attribution"]
     snap = next((e for e in reversed(events)
                  if e.get("event") == "fleet_series"), None)
     body: List[str] = [
@@ -191,7 +196,10 @@ def render_dash(events: Sequence[Dict[str, Any]],
             "inflight_slope", "saturation", "latency_p99_s",
             "store_hit_rate", "replicas_up", "replicas_total",
             "scrape_errors", "scrape_error_rate", "latency_anomaly",
-            "store_hit_anomaly") if last.get(k) is not None]
+            "store_hit_anomaly", "utilization", "idle_fraction",
+            "padding_waste", "cost_per_request_s", "demand_rps",
+            "capacity_rps", "headroom_rps",
+            "utilization_forecast") if last.get(k) is not None]
         if rows:
             body.append("<h2>Latest signals</h2>"
                         + _table(rows, ["signal", "value"]))
@@ -204,10 +212,37 @@ def render_dash(events: Sequence[Dict[str, Any]],
                      if isinstance(v, dict)]
             body.append("<h2>Per-tenant demand</h2>"
                         "<p class=meta>submitted/served/shed rates over "
-                        "the slow window; device-seconds estimated from "
-                        "the scraped dispatch p50.</p>"
+                        "the slow window; device-seconds measured from the "
+                        "scraped cost plane when present, else estimated "
+                        "from the dispatch p50.</p>"
                         + _table(trows, ["tenant", "submit/s", "served/s",
                                          "shed/s", "device_s"]))
+    # cost panel (ISSUE 19): the chargeback rows loadgen lands as
+    # cost_attribution extra events — utilization per engine, attributed
+    # device-seconds per tenant lane; absent for pre-cost-plane ledgers
+    if costs:
+        eng_rows = [[str(e.get("label", "serve")),
+                     _fmt(e.get("busy_fraction")),
+                     _fmt(e.get("idle_fraction")),
+                     _fmt(e.get("padding_waste")),
+                     _fmt(e.get("occupancy")),
+                     _fmt(e.get("cost_per_request_s"))]
+                    for e in costs if e.get("scope") == "engine"]
+        ten_rows = [[str(e.get("name", "?")), _fmt(e.get("requests")),
+                     _fmt(e.get("device_seconds")), _fmt(e.get("flops")),
+                     _fmt(e.get("saved_device_seconds"))]
+                    for e in costs if e.get("scope") == "tenant"]
+        body.append("<h2>Cost &amp; capacity</h2>"
+                    "<p class=meta>fair-share attribution "
+                    "(cost_attribution events — obs/cost.py); full "
+                    "showback: tools/cost_report.py &lt;ledger&gt;.</p>")
+        if eng_rows:
+            body.append(_table(eng_rows, ["engine", "busy_frac",
+                                          "idle_frac", "padding_waste",
+                                          "occupancy", "cost/req (s)"]))
+        if ten_rows:
+            body.append(_table(ten_rows, ["tenant", "requests", "device_s",
+                                          "flops", "saved_device_s"]))
     if incidents:
         irows = [[_fmt(e.get("t", "")), str(e.get("trigger", "?")),
                   str(e.get("detail", ""))[:120],
@@ -272,6 +307,9 @@ def write_dash(ledger_path: str, out_path: Optional[str] = None,
 
 
 def main(argv: List[str]) -> int:
+    if any(a in ("-h", "--help") for a in argv[1:]):
+        print(__doc__.strip())
+        return 0
     args = list(argv[1:])
     out = None
     title = "Fleet dashboard"

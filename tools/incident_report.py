@@ -393,6 +393,9 @@ def write_report(bundle: str, out_path: Optional[str] = None,
 
 
 def main(argv: List[str]) -> int:
+    if any(a in ("-h", "--help") for a in argv[1:]):
+        print(__doc__.strip())
+        return 0
     args = list(argv[1:])
     out: Optional[str] = None
     title: Optional[str] = None

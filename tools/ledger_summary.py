@@ -433,6 +433,9 @@ def render(events: List[Dict]) -> str:
 
 
 def main(argv: List[str]) -> int:
+    if any(a in ("-h", "--help") for a in argv[1:]):
+        print(__doc__.strip())
+        return 0
     if len(argv) != 2:
         print(__doc__.strip(), file=sys.stderr)
         return 2

@@ -28,7 +28,11 @@ regress by growing, gated by ``SEGMENT_RULES``), and SLO compliance
 regresses by growing, a compliant→violating flip always fails — gated by
 ``SLO_RULES``), and captured incidents (``incident`` events —
 obs/incident.py: ANY increase in bundle or suppressed-capture counts,
-overall or per trigger kind, regresses — gated by ``INCIDENT_RULES``)
+overall or per trigger kind, regresses — gated by ``INCIDENT_RULES``),
+and cost & capacity (``cost_attribution`` events — obs/cost.py:
+per-engine/tenant/program device-second attribution; cost-per-request
+and padding/idle waste regress by growing, utilization by dropping —
+gated by ``COST_RULES``)
 between a baseline run and a new run, renders per-program tables,
 evaluates the declarative regression rules (obs/history.py DEFAULT_RULES;
 scale every threshold with ``--threshold-scale``), and:
@@ -400,6 +404,37 @@ def render_diff(base: Dict, new: Dict, result: Dict) -> str:
                 _table(rows, ["label", "burn_fast", "burn_slow", "alerts",
                               "saturation", "scrape_err_rate", "up",
                               "advice"])]
+
+    # cost section (cost_attribution events — obs/cost.py, ISSUE 19):
+    # absent/empty for pre-PR-19 ledgers and cost-off runs, table omitted;
+    # cost_per_request and padding/idle waste regress by growing,
+    # busy_fraction (utilization) by DROPPING — gated by COST_RULES
+    costs = sorted(set(base.get("cost") or {}) | set(new.get("cost") or {}))
+    if costs:
+        rows = []
+        for label in costs:
+            b = (base.get("cost") or {}).get(label, {})
+            n = (new.get("cost") or {}).get(label, {})
+
+            def kcell(metric, b=b, n=n):
+                bv, nv = b.get(metric), n.get(metric)
+                if bv is None and nv is None:
+                    return "-"
+                if bv is None or nv is None:
+                    return f"{_fmt(bv)} → {_fmt(nv)}"
+                if bv == nv:
+                    return _fmt(nv)
+                return f"{_fmt(bv)} → {_fmt(nv)}"
+
+            rows.append([label, kcell("requests"), kcell("device_seconds"),
+                         kcell("cost_per_request_s"), kcell("busy_fraction"),
+                         kcell("padding_waste"), kcell("idle_fraction"),
+                         kcell("saved_device_seconds")])
+        out += ["", "cost & capacity (cost_attribution — cost_per_request/"
+                "padding/idle regress by growing, utilization by dropping):",
+                _table(rows, ["label", "requests", "device_s",
+                              "cost_per_req_s", "busy_frac", "padding_waste",
+                              "idle_frac", "saved_device_s"])]
 
     # incident section (incident events — obs/incident.py, ISSUE 18):
     # the overall "incident" label is seeded at zero on every run, so the

@@ -46,6 +46,9 @@ def flops_of(jitted, *args):
 
 
 def main():
+    if any(a in ("-h", "--help") for a in sys.argv[1:]):
+        print(__doc__.strip())
+        return 0
     cfg = UNet3DConfig.sd15()
     model = UNet3DConditionModel(config=cfg, dtype=jnp.bfloat16)
     F, STEPS = 8, 50

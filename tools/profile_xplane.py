@@ -125,6 +125,9 @@ def collect(trace_dir: str) -> dict:
 
 
 def main() -> None:
+    if any(a in ("-h", "--help") for a in sys.argv[1:]):
+        print(__doc__.strip())
+        return
     # jax only here: iter_device_events stays import-light for the
     # proto-parsing CLIs that share it (xplane_top_ops.py)
     import jax

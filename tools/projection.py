@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 from typing import Dict, List, Optional, Tuple
 
 # SD-1.5 UNet attention sites at 512² (64×64 latents): (N_spatial, channels,
@@ -247,6 +248,9 @@ def project_long(
 
 
 def main() -> None:
+    if any(a in ("-h", "--help") for a in sys.argv[1:]):
+        print(__doc__.strip())
+        return
     # measured single-chip phase times from the committed record; the
     # headline inversion_s/edit_s are the CACHED-mode pair — the projection
     # models the live sharded path, so prefer the live A/B readings

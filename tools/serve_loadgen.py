@@ -600,11 +600,15 @@ def main(argv=None) -> int:
 
         def collect_extra(record, client=target.client):
             # client-side reliability summary (the remote engine's own
-            # ledger holds the authoritative one); breaker trips read from
-            # the live /metrics when the engine still answers
+            # ledger holds the authoritative one); breaker trips and the
+            # cost plane's capacity section read from the live /metrics
+            # when the engine still answers
             trips = None
+            capacity = None
             try:
-                trips = client.metrics().get("breaker", {}).get("trips")
+                m = client.metrics()
+                trips = m.get("breaker", {}).get("trips")
+                capacity = m.get("capacity")
             except Exception:  # noqa: BLE001 — the engine may be gone
                 pass
             health = {
@@ -620,7 +624,15 @@ def main(argv=None) -> int:
             }
             if trips is not None:
                 health["breaker_trips"] = trips
-            return [health]
+            events = [health]
+            if isinstance(capacity, dict):
+                # ISSUE 19: the remote engine's capacity accounting lands
+                # as an engine-scope chargeback row so COST_RULES gate
+                # remote runs too (tenant rows stay on the engine ledger)
+                events.append({"event": "cost_attribution",
+                               "label": "serve", "scope": "engine",
+                               "name": "serve", **capacity})
+            return events
     elif args.router:
         from videop2p_tpu.cli.common import enable_compile_cache
         from videop2p_tpu.serve import (
@@ -671,6 +683,10 @@ def main(argv=None) -> int:
                 events += [dict(e) for e in r.engine.fault_log]
                 events.append({"event": "serve_health", "label": r.name,
                                **r.engine.health_record()})
+                # ISSUE 19: per-replica chargeback rows, labelled so the
+                # cost section keeps replicas distinct ("r0:tenant:A")
+                events += [{"event": "cost_attribution", "label": r.name,
+                            **row} for row in r.engine.cost_records()]
             record["router"] = router.health_record()
             events.append({"event": "router_health", **record["router"]})
             return events
@@ -698,10 +714,12 @@ def main(argv=None) -> int:
         def collect_extra(record, engine=engine):
             # the engine's own fault/breaker trail + reliability summary —
             # written into the loadgen ledger so ONE file gates both the
-            # latency (TIMING_RULES) and the reliability (FAULT_RULES)
+            # latency (TIMING_RULES) and the reliability (FAULT_RULES) —
+            # plus the cost plane's chargeback rows (COST_RULES, ISSUE 19)
             return [dict(e) for e in engine.fault_log] + [
                 {"event": "serve_health", **engine.health_record()}
-            ]
+            ] + [{"event": "cost_attribution", "label": "serve", **row}
+                 for row in engine.cost_records()]
 
     if args.collector:
         from videop2p_tpu.serve.collector import FleetCollector

@@ -14,7 +14,7 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from profile_xplane import iter_device_events  # noqa: E402
 
 
-def main(trace_dir: str, top: int = 40) -> None:
+def top_ops(trace_dir: str, top: int = 40) -> None:
     ops = collections.Counter()
     counts = collections.Counter()
     for name, ps in iter_device_events(trace_dir):
@@ -26,6 +26,17 @@ def main(trace_dir: str, top: int = 40) -> None:
         print(f"  {ps/1e12:8.3f} s  x{counts[name]:<5d} {name[:140]}")
 
 
+def main(argv) -> int:
+    if any(a in ("-h", "--help") for a in argv[1:]):
+        print(__doc__.strip())
+        return 0
+    trace_dir = argv[1] if len(argv) > 1 else "/tmp/xplane_trace"
+    if not os.path.isdir(trace_dir):
+        print(f"xplane_top_ops: no trace dir {trace_dir!r}", file=sys.stderr)
+        return 2
+    top_ops(trace_dir, int(argv[2]) if len(argv) > 2 else 40)
+    return 0
+
+
 if __name__ == "__main__":
-    main(sys.argv[1] if len(sys.argv) > 1 else "/tmp/xplane_trace",
-         int(sys.argv[2]) if len(sys.argv) > 2 else 40)
+    raise SystemExit(main(sys.argv))

@@ -66,6 +66,16 @@ Pillars (ISSUEs 2–4):
     ``scale_advice`` — gated by ``SIGNAL_RULES`` in obs_diff
     (``serve/collector.py`` is the scrape loop, ``tools/fleet_dash.py``
     the dashboard).
+  * :mod:`videop2p_tpu.obs.cost` — the cost & capacity plane (ISSUE
+    19): a :class:`CostModel` joining static program costs
+    (``program_analysis`` flops/bytes/HBM) with measured dispatch
+    seconds into per-request fair-share cost vectors, store-hit
+    amortization credits, per-tenant/per-program ``cost_attribution``
+    chargeback rows with a conservation invariant (attributed + padding
+    = busy; idle explicit), and the capacity record (busy/idle
+    fraction, padding waste, occupancy) that prices ``scale_advice`` —
+    gated by ``COST_RULES`` (``tools/cost_report.py`` renders the
+    showback).
   * :mod:`videop2p_tpu.obs.flight` — the always-on flight recorder
     (ISSUE 18): a bounded thread-safe ring of the most recent ledger
     events, teed from :meth:`RunLedger.event` at one guarded deque
@@ -109,12 +119,19 @@ from videop2p_tpu.obs.comm import (
     summarize_device_stats,
     tree_replica_divergence,
 )
+from videop2p_tpu.obs.cost import (
+    CAPACITY_FIELDS,
+    COST_ATTRIBUTION_FIELDS,
+    REQUEST_COST_FIELDS,
+    CostModel,
+)
 from videop2p_tpu.obs.flight import (
     FLIGHT_DEFAULT_CAPACITY,
     FlightRecorder,
 )
 from videop2p_tpu.obs.history import (
     COMM_RULES,
+    COST_RULES,
     DEFAULT_RULES,
     FAULT_RULES,
     INCIDENT_RULES,
@@ -267,6 +284,11 @@ __all__ = [
     "router_metrics_prometheus",
     "SIGNAL_RULES",
     "INCIDENT_RULES",
+    "COST_RULES",
+    "CAPACITY_FIELDS",
+    "COST_ATTRIBUTION_FIELDS",
+    "REQUEST_COST_FIELDS",
+    "CostModel",
     "FLIGHT_DEFAULT_CAPACITY",
     "FlightRecorder",
     "INCIDENT_FIELDS",

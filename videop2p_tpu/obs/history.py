@@ -48,6 +48,7 @@ __all__ = [
     "SEGMENT_RULES",
     "SIGNAL_RULES",
     "INCIDENT_RULES",
+    "COST_RULES",
     "split_runs",
     "extract_run",
     "evaluate_rules",
@@ -74,8 +75,10 @@ class RegressionRule:
     events — seam PSNRs, window failures), ``"slo"`` (per-objective
     compliance/budget-burn from ``slo_report`` events, obs/slo.py), or
     ``"segment"`` (per-critical-path-segment latency percentiles
-    aggregated from ``span`` events — queue/resolve/dispatch/decode).
-    ``min_abs`` suppresses verdicts
+    aggregated from ``span`` events — queue/resolve/dispatch/decode), or
+    ``"cost"`` (cost & capacity attribution from ``cost_attribution``
+    events, obs/cost.py — cost-per-request, busy/idle fraction, padding
+    waste). ``min_abs`` suppresses verdicts
     whose absolute delta is noise-sized (a 0.001 s phase doubling is not a
     regression). ``programs`` (labels for program/compile/dispatch kinds,
     phase names for phases) restricts the rule; None applies it everywhere.
@@ -253,6 +256,28 @@ INCIDENT_RULES: Tuple[RegressionRule, ...] = (
                    min_abs=0.5),
 )
 
+# cost & capacity gates (ISSUE 19): the serving engine's end-of-run
+# `cost_attribution` rows (obs/cost.py) — one engine-scope capacity
+# roll-up plus per-tenant/per-program chargeback aggregates. The cost of
+# a served request growing 15% regresses like a latency tail;
+# utilization (busy_fraction) regresses by DROPPING — the same fleet
+# doing the same work while sitting idler is capacity leaking away;
+# padding waste and idle fraction regress by growing, each with an
+# absolute floor so CPU-test micro-runs (sub-millisecond busy windows)
+# don't flag on jitter. Labels follow the serve_health pattern
+# ("serve", "serve:tenant:<name>", "serve:program:<label>"), so every
+# rule gates per-tenant and per-program rows wherever the metric lands.
+COST_RULES: Tuple[RegressionRule, ...] = (
+    RegressionRule("cost_per_request_s", kind="cost", threshold_pct=15.0,
+                   min_abs=0.001),
+    RegressionRule("busy_fraction", kind="cost", direction="decrease",
+                   threshold_pct=20.0, min_abs=0.02),
+    RegressionRule("padding_waste", kind="cost", threshold_pct=20.0,
+                   min_abs=0.02),
+    RegressionRule("idle_fraction", kind="cost", threshold_pct=20.0,
+                   min_abs=0.05),
+)
+
 DEFAULT_RULES: Tuple[RegressionRule, ...] = (
     RegressionRule("flops", threshold_pct=10.0),
     RegressionRule("bytes_accessed", threshold_pct=15.0, min_abs=1 << 20),
@@ -262,7 +287,8 @@ DEFAULT_RULES: Tuple[RegressionRule, ...] = (
     RegressionRule("seconds", kind="compile", threshold_pct=50.0, min_abs=1.0),
     RegressionRule("seconds", kind="phase", threshold_pct=25.0, min_abs=0.5),
 ) + (QUALITY_RULES + COMM_RULES + TIMING_RULES + FAULT_RULES + SEAM_RULES
-     + SLO_RULES + SEGMENT_RULES + SIGNAL_RULES + INCIDENT_RULES)
+     + SLO_RULES + SEGMENT_RULES + SIGNAL_RULES + INCIDENT_RULES
+     + COST_RULES)
 
 
 def split_runs(events: Iterable[Dict[str, Any]]) -> List[List[Dict[str, Any]]]:
@@ -332,6 +358,11 @@ def extract_run(events: Sequence[Dict[str, Any]],
         # run's first bundle to regress against it.
         "incidents": {"incident": {"count": 0.0, "suppressed": 0.0,
                                    "events": 0.0}},
+        # cost & capacity section (ISSUE 19) — empty for pre-PR-19
+        # ledgers (no seeded labels: unlike incidents, a run with no
+        # cost_attribution events has no cost SURFACE to regress, so an
+        # old baseline simply shares no labels and extracts clean)
+        "cost": {},
     }
     seg_samples: Dict[str, List[float]] = {}
     for e in events:
@@ -542,6 +573,25 @@ def extract_run(events: Sequence[Dict[str, Any]],
                 elif isinstance(v, (int, float)):
                     vals[k] = float(v)
             rec["slo"][name] = vals
+        elif kind == "cost_attribution":
+            # the cost plane's end-of-run chargeback rows (ISSUE 19,
+            # obs/cost.py): the engine-scope capacity roll-up lands
+            # under the event label ("serve"); tenant/program rows
+            # flatten like serve_health's tenants so COST_RULES gate
+            # each lane. A later row for the same label supersedes
+            # (reopened engine over one ledger).
+            base_label = e.get("label") or "serve"
+            scope = e.get("scope") or "engine"
+            name = e.get("name")
+            if scope == "engine" or name is None:
+                label = base_label
+            else:
+                label = f"{base_label}:{scope}:{name}"
+            rec["cost"][label] = {
+                k: float(v) for k, v in e.items()
+                if k not in ("event", "t", "label", "scope", "name")
+                and isinstance(v, (int, float)) and not isinstance(v, bool)
+            }
         elif kind == "incident":
             # capture counts accumulate over the run, overall AND per
             # trigger kind — INCIDENT_RULES then flags any label that
@@ -598,7 +648,7 @@ def _rule_values(record: Dict[str, Any], rule: RegressionRule) -> Dict[str, floa
     elif rule.kind == "divergence":
         out = {k: float(v) for k, v in record.get("divergence", {}).items()}
     elif rule.kind in ("timing", "trace", "reliability", "stream", "slo",
-                       "segment", "signal", "incident"):
+                       "segment", "signal", "incident", "cost"):
         section = {"segment": "segments", "signal": "signals",
                    "incident": "incidents"}.get(rule.kind, rule.kind)
         for label, m in record.get(section, {}).items():

@@ -186,6 +186,12 @@ class RunLedger:
         # with flight=None (the default) the extra cost is one attribute
         # check and the written stream is bit-exact either way.
         self.flight: Optional[Any] = None
+        # program-analysis observers (ISSUE 19): callbacks fired with
+        # (program, record) on every program_analysis event — the serving
+        # CostModel registers here to mine static costs as they compile.
+        # Empty list (the default) adds one truthiness check; observers
+        # never raise into the ledger.
+        self.analysis_observers: List[Any] = []
         self._t0 = time.perf_counter()
         self._closed = False
         self._activated = False
@@ -314,7 +320,16 @@ class RunLedger:
 
     def program_analysis(self, program: str, record: Dict[str, Any]) -> None:
         """Record one compiled-program introspection record
-        (obs.introspect.analyze_compiled/analyze_jitted) for ``program``."""
+        (obs.introspect.analyze_compiled/analyze_jitted) for ``program``.
+        Registered ``analysis_observers`` (the serving CostModel) see the
+        same (program, record) pair; an observer raising never blocks the
+        event write."""
+        if self.analysis_observers:
+            for cb in list(self.analysis_observers):
+                try:
+                    cb(program, record)
+                except Exception:  # noqa: BLE001 — obs never raises
+                    pass
         self.event("program_analysis", program=program, **record)
 
     def comm_analysis(self, program: str, record: Dict[str, Any]) -> None:

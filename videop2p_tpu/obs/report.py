@@ -750,6 +750,9 @@ def write_report(ledger_path: str, out_path: Optional[str] = None,
 
 def main(argv: List[str]) -> int:
     """CLI: edit_report.py <ledger.jsonl> [-o report.html] [--sidecar X.npz]"""
+    if any(a in ("-h", "--help") for a in argv[1:]):
+        print(main.__doc__)
+        return 0
     args = list(argv[1:])
     out = sidecar = None
     pos = []

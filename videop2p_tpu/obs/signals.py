@@ -22,9 +22,18 @@ human actually acts on:
     dispatch p50: "how many dispatches deep is the queue" in time units;
     the classic rho > 1 saturation smell scaled to observed service time.
   * **per-tenant demand metering** — submitted/served/shed rates per
-    tenant lane over the slow window plus estimated device-seconds
-    (served increase x the fleet dispatch p50 — reservoir summaries are
-    the only per-request duration surface the scrape exposes).
+    tenant lane over the slow window plus device-seconds: the MEASURED
+    fair-share attributed counter scraped from the cost plane (ISSUE
+    19) when a target exposes it, else the estimate (served increase x
+    the fleet dispatch p50) pre-cost-plane fleets always had.
+  * **utilization & headroom economics (ISSUE 19)** — replica
+    busy-fraction/padding-waste/cost-per-request from the scraped
+    ``capacity`` section become fleet utilization, idle fraction, a
+    Theil–Sen utilization forecast one slow window out, and demand vs
+    measured dispatch capacity (headroom in requests/s); scale advice
+    gains economic reasons (shrink-is-cheap when idle, priced holds).
+    Everything is None — and the advice identical to pre-ISSUE-19 —
+    when no target exposes the cost plane.
   * **EWMA anomaly flags** — exponentially-weighted mean/variance per
     watched headline (latency p99 up, store hit-rate down); a flag is a
     deviation beyond ``tolerance`` sigmas with an absolute floor.
@@ -62,6 +71,9 @@ __all__ = [
     "S_SCRAPES",
     "S_SCRAPE_ERRORS",
     "S_TENANT",
+    "S_BUSY_FRACTION",
+    "S_PADDING_WASTE",
+    "S_COST_PER_REQUEST",
 ]
 
 # ---- the series-name contract between collector and signals --------------
@@ -79,6 +91,10 @@ S_STORE_HIT_RATE = "store_hit_rate"     # labels {replica}
 S_SCRAPES = "scrapes_total"             # cumulative, labels {replica}
 S_SCRAPE_ERRORS = "scrape_errors_total"  # cumulative, labels {replica}
 S_TENANT = "tenant_total"   # cumulative, labels {replica, tenant, field}
+# ISSUE 19 cost/capacity gauges scraped from /metrics `capacity`
+S_BUSY_FRACTION = "busy_fraction"           # 0..1 gauge, labels {replica}
+S_PADDING_WASTE = "padding_waste"           # gauge, labels {replica}
+S_COST_PER_REQUEST = "cost_per_request_s"   # gauge, labels {replica}
 
 # request statuses that mean "the engine failed the request" vs finished
 ERROR_STATUSES = ("error", "deadline_exceeded")
@@ -114,6 +130,18 @@ FLEET_SIGNALS_FIELDS = (
     # that burned the budget even outside an incident bundle. Always
     # present; {} when no target exposes exemplars (tracing off).
     "exemplars",
+    # utilization/headroom economics (ISSUE 19): all None when no target
+    # exposes the cost plane's `capacity` section — pre-cost fleets keep
+    # the exact pre-ISSUE-19 advice behaviour.
+    "utilization",
+    "idle_fraction",
+    "padding_waste",
+    "cost_per_request_s",
+    "demand_rps",
+    "capacity_rps",
+    "headroom_rps",
+    "utilization_slope",
+    "utilization_forecast",
     "scale_advice",
     "reasons",
 )
@@ -291,7 +319,10 @@ class SignalEngine:
     def _tenant_demand(self, now: float,
                        dispatch_p50: Optional[float]) -> Dict[str, Any]:
         """Per-lane submitted/served/shed rates over the slow window plus
-        estimated device-seconds (served increase x dispatch p50)."""
+        device-seconds: the MEASURED fair-share counter (ISSUE 19 — the
+        collector meters the engine's attributed ``device_seconds`` per
+        lane) when the series exists, else the pre-cost-plane estimate
+        (served increase x dispatch p50)."""
         lanes: Dict[str, Dict[str, float]] = {}
         sums: Dict[str, Dict[str, float]] = {}
         for ls in self.tsdb.labelsets(S_TENANT):
@@ -308,15 +339,70 @@ class SignalEngine:
             acc[f"{fld}_rate"] = acc.get(f"{fld}_rate", 0.0) + rate
         for tenant, acc in sorted(sums.items()):
             served_inc = acc.get("done_inc", 0.0)
+            if "device_seconds_inc" in acc:
+                # measured plane: attributed device-seconds counter
+                device_s = acc["device_seconds_inc"]
+            else:
+                device_s = served_inc * (dispatch_p50 or 0.0)
             lanes[tenant] = {
                 "submitted_rate": round(acc.get("submitted_rate", 0.0), 6),
                 "served_rate": round(acc.get("done_rate", 0.0), 6),
                 "shed_rate": round(acc.get("shed_rate", 0.0)
                                    + acc.get("rejected_rate", 0.0), 6),
-                "device_seconds": round(
-                    served_inc * (dispatch_p50 or 0.0), 6),
+                "device_seconds": round(device_s, 6),
             }
         return lanes
+
+    def _capacity_signals(self, now: float,
+                          demand_rps: float) -> Dict[str, Any]:
+        """Utilization/headroom economics (ISSUE 19) from the scraped
+        cost-plane gauges: fleet utilization is the mean replica
+        busy-fraction, capacity is what the up replicas could absorb at
+        the observed per-request device cost, and the forecast projects
+        a Theil–Sen utilization trend one slow window out. Every value
+        is None when no target exposes the ``capacity`` section, so
+        pre-cost-plane fleets evaluate exactly as before."""
+        busy_vals: List[float] = []
+        waste_vals: List[float] = []
+        cpr_vals: List[float] = []
+        for ls in self._replica_labels():
+            rl = {"replica": ls.get("replica")}
+            b = self.tsdb.latest(S_BUSY_FRACTION, rl)
+            if b is not None:
+                busy_vals.append(b[1])
+            w = self.tsdb.latest(S_PADDING_WASTE, rl)
+            if w is not None:
+                waste_vals.append(w[1])
+            c = self.tsdb.latest(S_COST_PER_REQUEST, rl)
+            if c is not None and c[1] > 0.0:
+                cpr_vals.append(c[1])
+        out: Dict[str, Any] = {
+            "utilization": None, "idle_fraction": None,
+            "padding_waste": None, "cost_per_request_s": None,
+            "demand_rps": round(demand_rps, 6), "capacity_rps": None,
+            "headroom_rps": None, "utilization_slope": None,
+            "utilization_forecast": None,
+        }
+        if not busy_vals:
+            return out
+        utilization = sum(busy_vals) / len(busy_vals)
+        out["utilization"] = round(utilization, 6)
+        out["idle_fraction"] = round(max(0.0, 1.0 - utilization), 6)
+        if waste_vals:
+            out["padding_waste"] = round(
+                sum(waste_vals) / len(waste_vals), 6)
+        cpr = (sum(cpr_vals) / len(cpr_vals)) if cpr_vals else None
+        if cpr is not None:
+            out["cost_per_request_s"] = round(cpr, 6)
+            capacity_rps = len(busy_vals) / cpr
+            out["capacity_rps"] = round(capacity_rps, 6)
+            out["headroom_rps"] = round(capacity_rps - demand_rps, 6)
+        slope = (self._fleet_slope(S_BUSY_FRACTION, now, self.slow_window_s)
+                 / max(len(busy_vals), 1))
+        out["utilization_slope"] = round(slope, 8)
+        out["utilization_forecast"] = round(
+            min(1.0, max(0.0, utilization + slope * self.slow_window_s)), 6)
+        return out
 
     def _scrape_stats(self, now: float) -> Tuple[float, float]:
         scrapes = errors = 0.0
@@ -386,6 +472,9 @@ class SignalEngine:
         dp_vals = [v[1] for v in dp_vals if v is not None]
         dispatch_p50 = (sum(dp_vals) / len(dp_vals)) if dp_vals else None
         tenants = self._tenant_demand(t, dispatch_p50)
+        demand_rps = sum(lane.get("submitted_rate", 0.0)
+                         for lane in tenants.values())
+        economics = self._capacity_signals(t, demand_rps)
 
         # ---- scale advice ------------------------------------------------
         reasons: List[str] = []
@@ -428,6 +517,34 @@ class SignalEngine:
                 reasons.append("fleet idle over the slow window")
             else:
                 advice = "hold"
+        # economic reasons (ISSUE 19): when the cost plane is scraped,
+        # every piece of advice is PRICED — shrink cites the idle
+        # fraction it reclaims, grow cites the utilization forecast, and
+        # hold carries the utilization/cost annotation the showback and
+        # the loadgen acceptance read. Absent cost plane: no change.
+        util = economics.get("utilization")
+        if util is not None:
+            idle_f = economics.get("idle_fraction") or 0.0
+            cpr = economics.get("cost_per_request_s")
+            cpr_part = (f", cost_per_request {cpr:.4f}s"
+                        if cpr is not None else "")
+            if advice == "shrink":
+                reasons.append(
+                    f"shrink-is-cheap: idle_fraction {idle_f:.2f}"
+                    + cpr_part)
+            elif advice == "grow":
+                fc = economics.get("utilization_forecast")
+                reasons.append(
+                    f"economics: utilization {util:.2f}"
+                    + (f", forecast {fc:.2f}" if fc is not None else "")
+                    + cpr_part)
+            else:
+                head = economics.get("headroom_rps")
+                reasons.append(
+                    f"economics: utilization {util:.2f}, "
+                    f"idle_fraction {idle_f:.2f}" + cpr_part
+                    + (f", headroom {head:.2f} rps"
+                       if head is not None else ""))
         self.evaluations += 1
         self.advice_counts[advice] = self.advice_counts.get(advice, 0) + 1
 
@@ -461,6 +578,15 @@ class SignalEngine:
             "tenants": tenants,
             "exemplars": {k: dict(v) for k, v in
                           sorted(self._exemplars.items())},
+            "utilization": economics["utilization"],
+            "idle_fraction": economics["idle_fraction"],
+            "padding_waste": economics["padding_waste"],
+            "cost_per_request_s": economics["cost_per_request_s"],
+            "demand_rps": economics["demand_rps"],
+            "capacity_rps": economics["capacity_rps"],
+            "headroom_rps": economics["headroom_rps"],
+            "utilization_slope": economics["utilization_slope"],
+            "utilization_forecast": economics["utilization_forecast"],
             "scale_advice": advice,
             "reasons": reasons,
         }

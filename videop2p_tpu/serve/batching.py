@@ -91,6 +91,14 @@ class Batch:
     def pad(self) -> int:
         return self.padded_size - len(self.items)
 
+    @property
+    def occupancy(self) -> float:
+        """Real-slot fraction of the dispatch (ISSUE 19): the cost plane
+        prices each dispatch over ``padded_size`` slots, so ``1 -
+        occupancy`` is exactly the padding share that lands as
+        ``padding_seconds`` in the capacity ledger."""
+        return len(self.items) / self.padded_size if self.padded_size else 1.0
+
 
 def plan_batches(
     items: Sequence[Any],

@@ -38,10 +38,13 @@ from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 from videop2p_tpu.obs.signals import (
     FINISHED_STATUSES,
+    S_BUSY_FRACTION,
+    S_COST_PER_REQUEST,
     S_DISPATCH_P50,
     S_IN_FLIGHT,
     S_LATENCY_P50,
     S_LATENCY_P99,
+    S_PADDING_WASTE,
     S_QUEUE_DEPTH,
     S_QUEUE_WAIT_P99,
     S_REQUESTS,
@@ -57,8 +60,10 @@ from videop2p_tpu.serve.client import EngineClient
 
 __all__ = ["FleetCollector", "ingest_engine_metrics", "ingest_prom_samples"]
 
-# tenant counter fields metered per lane (cumulative; rates downstream)
-_TENANT_COUNTER_FIELDS = ("submitted", "done", "errors", "shed", "rejected")
+# tenant counter fields metered per lane (cumulative; rates downstream);
+# device_seconds is the ISSUE-19 measured fair-share attribution counter
+_TENANT_COUNTER_FIELDS = ("submitted", "done", "errors", "shed", "rejected",
+                          "device_seconds")
 
 # prometheus exposition name → our ingest series (the reverse of the
 # render mapping in obs/prom.py for exactly the gauges the collector keeps)
@@ -68,6 +73,10 @@ _PROM_MAP = {
     "videop2p_request_latency_blocked_p50_s": S_LATENCY_P50,
     "videop2p_request_latency_blocked_p99_s": S_LATENCY_P99,
     "videop2p_store_hit_rate": S_STORE_HIT_RATE,
+    # ISSUE 19 capacity gauges (the generic `capacity` section render)
+    "videop2p_capacity_busy_fraction": S_BUSY_FRACTION,
+    "videop2p_capacity_padding_waste": S_PADDING_WASTE,
+    "videop2p_capacity_cost_per_request_s": S_COST_PER_REQUEST,
 }
 
 # the exposition renders ``programs`` as labeled series
@@ -120,6 +129,16 @@ def ingest_engine_metrics(tsdb: TimeSeriesStore, name: str, t: float,
         v = _num(store.get("hit_rate"))
         if v is not None:
             wrote += tsdb.add(S_STORE_HIT_RATE, t, v, labels)
+    capacity = metrics.get("capacity")
+    if isinstance(capacity, dict):
+        # ISSUE 19: the cost plane's utilization gauges — the prom path
+        # lands the same three via _PROM_MAP (round-trip pinned)
+        for key, series in (("busy_fraction", S_BUSY_FRACTION),
+                            ("padding_waste", S_PADDING_WASTE),
+                            ("cost_per_request_s", S_COST_PER_REQUEST)):
+            v = _num(capacity.get(key))
+            if v is not None:
+                wrote += tsdb.add(series, t, v, labels)
     requests = metrics.get("requests")
     if isinstance(requests, dict):
         # zero-fill the terminal statuses: the engine's by-status record

@@ -50,6 +50,16 @@ injected fault / breaker transition becomes a ``fault`` / ``breaker``
 event; closing the engine writes one ``serve_health`` summary gated by
 ``FAULT_RULES`` through ``tools/obs_diff.py`` like any other run record.
 
+Cost & capacity plane (ISSUE 19 — :mod:`videop2p_tpu.obs.cost`): every
+successful dispatch is priced by fair share over its padded slots, so
+terminal ``done`` records carry a per-request ``cost`` vector
+(device/queue seconds, attributed flops and HBM-byte-seconds, padding
+share; store hits credited the avoided inversion), ``/metrics`` grows a
+``capacity`` section (busy/idle fraction, padding waste, occupancy) and
+close() emits per-tenant/per-program ``cost_attribution`` chargeback
+rows with the conservation invariant attributed + padding = busy, idle
+explicit — gated by ``COST_RULES``.
+
 Stdlib+numpy+jax only — the import-guard test walks this package.
 """
 
@@ -89,6 +99,7 @@ from videop2p_tpu.serve.faults import (
     RetryPolicy,
     is_transient,
 )
+from videop2p_tpu.obs.cost import CostModel
 from videop2p_tpu.obs.spans import (
     Tracer,
     make_span_id,
@@ -333,6 +344,17 @@ class EditEngine:
         self.tracer = Tracer(self.ledger, enabled=tracing)
         self._tracing = self.tracer.enabled
         self._slo = bool(slo)
+        # cost & capacity plane (ISSUE 19 — obs/cost.py): static program
+        # costs stream in through the ledger's analysis observer as
+        # programs compile; the worker prices every successful dispatch
+        # by fair share, terminal records carry the per-request cost
+        # vector, and close() emits the cost_attribution chargeback rows
+        self.cost = CostModel()
+        self.ledger.analysis_observers.append(self.cost.observe_program)
+        # per-rid fresh-inversion attribution, folded into the terminal
+        # cost vector by _finish (a failed request's entry just ages out
+        # with the engine — its seconds are already in the capacity books)
+        self._resolve_costs: Dict[str, Dict[str, Any]] = {}
         # most-recent-wins ring (ISSUE 18 satellite): a long chaos run
         # must keep the LAST 256 fault/breaker entries — the ones an
         # incident needs — not the first 256. deque(maxlen=...) evicts
@@ -614,8 +636,9 @@ class EditEngine:
             in_flight = self._inflight
         timing = self.ledger.execute_timing_summary()
         request_latency = timing.get("serve_request_e2e")
+        uptime_s = time.perf_counter() - self.started
         return {
-            "uptime_s": round(time.perf_counter() - self.started, 3),
+            "uptime_s": round(uptime_s, 3),
             "spec_fingerprint": self._spec_fp,
             "warm": self.programs.warmed,
             "requests": by_status,
@@ -633,6 +656,11 @@ class EditEngine:
             },
             "request_latency": request_latency,
             "programs": timing,
+            # capacity accounting (ISSUE 19): busy/idle fraction, padding
+            # waste, slot occupancy, cost-per-request — the collector
+            # meters these into utilization/headroom series and priced
+            # scale_advice (JSON and Prometheus expose the same record)
+            "capacity": self.cost.capacity(uptime_s),
             "devices": self._device_memory(),
         }
 
@@ -641,6 +669,11 @@ class EditEngine:
         outcomes plus error/shed rates per tenant lane."""
         with self._counter_lock:
             counters = {t: dict(c) for t, c in self.tenant_counters.items()}
+        # measured per-tenant attribution (ISSUE 19): cumulative device-
+        # seconds and cache savings join the QoS counters — the fleet
+        # collector meters these as counter series, so signals' demand
+        # lanes report MEASURED device-seconds, not a scrape estimate
+        costs = self.cost.tenant_costs()
         out: Dict[str, Dict[str, Any]] = {}
         for t, c in counters.items():
             done = c.get("done", 0)
@@ -649,12 +682,16 @@ class EditEngine:
             finished = (done + errors + deadline_exceeded
                         + c.get("engine_closed", 0))
             attempts = c.get("submitted", 0) + c.get("shed", 0) + c.get("rejected", 0)
+            tcost = costs.get(t, {})
             out[t] = {
                 **c,
                 "error_rate": (round((errors + deadline_exceeded) / finished, 4)
                                if finished else 0.0),
                 "shed_rate": (round((c.get("shed", 0) + c.get("rejected", 0))
                                     / attempts, 4) if attempts else 0.0),
+                "device_seconds": round(tcost.get("device_seconds", 0.0), 6),
+                "saved_device_seconds": round(
+                    tcost.get("saved_device_seconds", 0.0), 6),
             }
         return out
 
@@ -676,6 +713,7 @@ class EditEngine:
         shed = self.counters["shed"]
         rejected = self.counters["rejected_unavailable"]
         attempts = admitted + shed + rejected
+        capacity = self.cost.capacity(time.perf_counter() - self.started)
         return {
             "requests": admitted,
             "done": done,
@@ -697,8 +735,19 @@ class EditEngine:
             "scheduler": self.scheduler.name,
             "queue_wait_mean_s": (round(self._qw_sum / self._qw_count, 4)
                                   if self._qw_count else 0.0),
+            "busy_fraction": capacity["busy_fraction"],
+            "padding_waste": capacity["padding_waste"],
             "tenants": self._tenant_records(),
         }
+
+    def cost_records(self) -> List[Dict[str, Any]]:
+        """The live ``cost_attribution`` rows (obs/cost.py,
+        ``COST_ATTRIBUTION_FIELDS``): the engine-scope capacity roll-up
+        plus the per-tenant / per-program chargeback aggregates — what
+        close() emits, readable any time (the loadgen lands them into
+        its own ledger the way it lands ``serve_health``)."""
+        return self.cost.attribution_records(
+            time.perf_counter() - self.started)
 
     def close(self, *, drain_s: float = 0.0) -> None:
         """Stop admitting, stop the worker, and FAIL every still-pending
@@ -750,6 +799,12 @@ class EditEngine:
                 ))
             except Exception:  # noqa: BLE001 — obs never blocks shutdown
                 pass
+        # the chargeback ledger (ISSUE 19): one engine-scope capacity
+        # roll-up (the conservation invariant on the books: attributed +
+        # padding = busy, idle explicit) plus one row per tenant and per
+        # program — before serve_health so one run record carries both
+        for row in self.cost_records():
+            self.ledger.event("cost_attribution", label="serve", **row)
         self.ledger.event("serve_health", **health)
         self.ledger.event("serve_shutdown", requests=len(self._requests))
         if self.incidents is not None and self._own_incidents:
@@ -1050,6 +1105,21 @@ class EditEngine:
                           "width": self.spec.width,
                           "video_len": self.spec.video_len},
                 )
+            if source == "fresh":
+                # the measured price one store hit avoids: this clip's
+                # encode + capture-inversion resolve seconds (slightly
+                # over the pure inversion — the controller/prompt-encode
+                # share is common to hits too, and small next to it).
+                # The same seconds are PRICED to this request as a
+                # singleton serve_invert attribution: a cold request
+                # carries its inversion in the cost vector, so a store
+                # hit's attributed cost is measurably lower — and the
+                # inversion seconds stay inside the conservation books
+                # (busy += attributed, no padding).
+                inv_s = time.perf_counter() - t0
+                self.cost.note_fresh_inversion(inv_s)
+                self._resolve_costs[rid] = self.cost.price_dispatch(
+                    inv_s, real=1, padded=1, program="serve_invert")
             cached, anchor = products
             ctx_edit = ctx
             if steps != self.spec.steps:
@@ -1189,10 +1259,16 @@ class EditEngine:
             budgets = [b for b in budgets if b is not None]
             budget = min(budgets) if budgets else None
             t0 = time.perf_counter()
+            # per-dispatch occupancy (ISSUE 19 satellite): how many of
+            # this dispatch's padded slots carry REAL requests — the
+            # padding-waste denominator, threaded into every member's
+            # record and the /metrics capacity section
+            occupancy = {"real": len(live), "padded": plan.padded_size}
             for p in live:
                 self._update(p.rid, status="running",
                              batch_size=len(plan.items),
                              padded_size=plan.padded_size,
+                             batch_occupancy=dict(occupancy),
                              dispatch_attempts=attempt + 1)
             try:
                 outs = self._watchdog_dispatch(plan, budget)
@@ -1231,11 +1307,21 @@ class EditEngine:
             tid0 = (self._emit_dispatch_spans(live, t0, dt)
                     if self._tracing else None)
             self.ledger.record_execute("serve_dispatch", dt, dt, tid0)
+            # fair-share cost attribution (ISSUE 19): the dispatch's
+            # blocked seconds split per padded slot — live members each
+            # get one slot's share, the pad slots land in the padding-
+            # waste line, so attribution + padding sums back to dt
+            batched_label, singleton_label = self._cost_labels(plan)
+            cost_slot = self.cost.price_dispatch(
+                dt, real=len(live), padded=plan.padded_size,
+                program=batched_label, singleton=singleton_label,
+            )
             for p, (videos, src_err) in zip(plan.items, outs):
                 if p.rid in failed:
                     continue
                 self._finish(p.rid, np.asarray(jax.device_get(videos)),
-                             float(np.asarray(jax.device_get(src_err))), dt)
+                             float(np.asarray(jax.device_get(src_err))), dt,
+                             cost_slot=cost_slot)
             return
 
     def _emit_dispatch_spans(self, live, t0: float,
@@ -1276,8 +1362,31 @@ class EditEngine:
             )
         return first_tid
 
+    def _cost_labels(self, plan) -> Tuple[str, str]:
+        """The (dispatched, singleton) program labels of one plan — the
+        CostModel's static-cost lookup keys, mirroring the label scheme
+        :mod:`videop2p_tpu.serve.programs` compiles under (so the join
+        lands on the exact analyzed program when it has compiled, and
+        falls back to the singleton's per-item statics otherwise)."""
+        from videop2p_tpu.pipelines.reuse import reuse_label
+
+        p0 = plan.items[0]
+        suffix = "" if p0.steps == self.spec.steps else f"_s{p0.steps}"
+        rl = reuse_label(p0.reuse)
+        if rl:
+            suffix += f"_r{rl}"
+        if p0.student:
+            suffix += "_stu"
+        singleton = f"serve_edit{suffix}"
+        if plan.padded_size == 1:
+            return singleton, singleton
+        batched = (f"serve_edit_b{plan.padded_size}"
+                   f"_{self.batch_dispatch}{suffix}")
+        return batched, singleton
+
     def _finish(self, rid: str, videos: np.ndarray, src_err: float,
-                dispatch_s: float) -> None:
+                dispatch_s: float,
+                cost_slot: Optional[Dict[str, Any]] = None) -> None:
         from videop2p_tpu.utils.video_io import save_video_gif
 
         rec = self.poll(rid)
@@ -1305,10 +1414,54 @@ class EditEngine:
         self.ledger.record_execute("serve_request_e2e", total, total, tid)
         compile_events = (len(self.ledger.compile_seconds)
                           - rec.get("compile_events_before", 0))
+        # the per-request cost vector (ISSUE 19, REQUEST_COST_FIELDS):
+        # this slot's fair share of the dispatch plus its own queue
+        # seconds; a store hit is additionally credited the inversion it
+        # avoided, priced from the same model
+        slot = cost_slot or {}
+        # a cold request folds in its own fresh-inversion attribution
+        # (priced in _resolve); store hits have no entry here — that is
+        # exactly the spend they avoided
+        inv = self._resolve_costs.pop(rid, None) or {}
+        cost = {
+            "program": slot.get("program", "serve_edit"),
+            "device_seconds": round(slot.get("device_seconds", 0.0)
+                                    + inv.get("device_seconds", 0.0), 6),
+            "flops": slot.get("flops", 0.0) + inv.get("flops", 0.0),
+            "hbm_byte_seconds": (slot.get("hbm_byte_seconds", 0.0)
+                                 + inv.get("hbm_byte_seconds", 0.0)),
+            "queue_seconds": round(rec.get("queue_wait_s") or 0.0, 6),
+            "padding_share": round(slot.get("padding_share", 0.0), 6),
+            "saved_device_seconds": 0.0,
+            "saved_flops": 0.0,
+        }
+        store_hit = bool(rec.get("store_hit"))
+        if store_hit:
+            saved = self.cost.savings()
+            cost["saved_device_seconds"] = round(
+                saved["saved_device_seconds"], 6)
+            cost["saved_flops"] = saved["saved_flops"]
+        # program split: the dispatch slot under the edit program, a cold
+        # request's fresh inversion under serve_invert — so the
+        # per-program ledger joins cleanly against each label's static
+        # cost (the parts sum to the tenant's vector)
+        programs = [(cost["program"],
+                     {**cost,
+                      "device_seconds": round(
+                          slot.get("device_seconds", 0.0), 6),
+                      "flops": slot.get("flops", 0.0),
+                      "hbm_byte_seconds": slot.get("hbm_byte_seconds",
+                                                   0.0)})]
+        if inv:
+            programs.append(("serve_invert", inv))
+        self.cost.account_request(tenant=rec.get("tenant", "default"),
+                                  cost=cost, store_hit=store_hit,
+                                  programs=programs)
         self._terminalize(
             rid, "done",
             dispatch_s=round(dispatch_s, 4), total_s=round(total, 4),
             src_err=src_err, compile_events=compile_events,
+            cost=cost,
             inversion_gif=inversion_gif, edit_gif=edit_gif,
         )
         self.ledger.event(

@@ -76,6 +76,9 @@ SERVE_HEALTH_FIELDS = (
     "shed", "rejected_unavailable", "error_rate", "shed_rate",
     "breaker_trips", "retries", "faults_injected", "rehydrations",
     "fresh_inversions", "store_corrupt", "queue_wait_mean_s",
+    # ISSUE 19 capacity facts: replica busy fraction and padding waste
+    # ride serve_health/healthz so the fleet collector sees utilization.
+    "busy_fraction", "padding_waste",
 )
 
 # per-tenant QoS sub-records (ISSUE 11): the `serve_health` event's
@@ -85,6 +88,9 @@ SERVE_HEALTH_FIELDS = (
 SERVE_TENANT_FIELDS = (
     "submitted", "done", "errors", "deadline_exceeded", "engine_closed",
     "shed", "rejected", "error_rate", "shed_rate",
+    # ISSUE 19 chargeback facts: measured attributed device-seconds and
+    # store-hit savings per lane (obs/cost.py fair-share attribution).
+    "device_seconds", "saved_device_seconds",
 )
 
 

@@ -97,6 +97,7 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if url.path == "/healthz":
                 breaker = self.engine.breaker.snapshot()
+                health = self.engine.health_record()
                 self._send(200, {
                     "ok": True,
                     # load balancers key on "ok" (liveness); orchestrators
@@ -106,6 +107,10 @@ class _Handler(BaseHTTPRequestHandler):
                     "breaker": breaker,
                     "warm": self.engine.programs.warmed,
                     "spec_fingerprint": self.engine.spec.fingerprint(),
+                    # ISSUE 19: per-replica capacity facts ride healthz so
+                    # scrapers get utilization without the full /metrics body
+                    "busy_fraction": health.get("busy_fraction", 0.0),
+                    "padding_waste": health.get("padding_waste", 0.0),
                 })
                 return
             if url.path == "/metrics":
