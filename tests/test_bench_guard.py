@@ -520,7 +520,10 @@ def test_report_and_obs_import_only_stdlib_numpy_jax():
              os.path.join(_REPO, "tools", "incident_report.py"),
              # ISSUE 19 pin: the showback report ships in chargeback
              # emails — stdlib+numpy SVG bars, no plotting stack
-             os.path.join(_REPO, "tools", "cost_report.py")]
+             os.path.join(_REPO, "tools", "cost_report.py"),
+             # ISSUE 20 pin: the correctness report ships in bug reports
+             # too — stdlib+numpy SVG timelines, no plotting stack
+             os.path.join(_REPO, "tools", "probe_report.py")]
     obs_dir = os.path.join(_REPO, "videop2p_tpu", "obs")
     obs_files = sorted(f for f in os.listdir(obs_dir) if f.endswith(".py"))
     # ISSUE 6 pins: the time-domain modules are IN the guarded set — the
@@ -537,11 +540,14 @@ def test_report_and_obs_import_only_stdlib_numpy_jax():
     # serving process, so both stay stdlib(+numpy via the sidecar)
     # ISSUE 19 pins: the cost plane joins — the attribution model runs
     # inside every engine, so it stays stdlib+numpy
+    # ISSUE 20 pins: the correctness plane joins — the known-answer
+    # probe suite and the answer audit run inside every prober/engine
+    # process, so they stay stdlib
     assert {"timing.py", "trace.py",
             "spans.py", "slo.py", "prom.py",
             "tsdb.py", "signals.py",
             "flight.py", "incident.py",
-            "cost.py"} <= set(obs_files)
+            "cost.py", "probe.py"} <= set(obs_files)
     files += [os.path.join(obs_dir, f) for f in obs_files]
     # ISSUE 7 pins: the serving subsystem is IN the guarded set — the
     # HTTP layer stays stdlib http.server/urllib (no flask/requests), and
@@ -556,9 +562,11 @@ def test_report_and_obs_import_only_stdlib_numpy_jax():
     # box with nothing beyond the stdlib HTTP stack
     # ISSUE 17 pin: the scrape loop joins — the collector must deploy on
     # any box the router does (stdlib urllib probes, no requests)
+    # ISSUE 20 pin: the probing loop joins — the prober deploys next to
+    # the router (stdlib urllib canaries, no requests)
     assert {"engine.py", "store.py", "batching.py", "programs.py",
             "http.py", "client.py", "faults.py", "sched.py", "replica.py",
-            "router.py", "collector.py"} <= set(serve_files)
+            "router.py", "collector.py", "prober.py"} <= set(serve_files)
     files += [os.path.join(serve_dir, f) for f in serve_files]
     # ISSUE 12 pin: the streaming tier (window plan, resumable manifest,
     # job driver) joins the guarded set — resume/chaos machinery must run
@@ -936,7 +944,7 @@ def test_incident_ledger_event_schema(tmp_path):
     assert all(r.threshold_pct == 0.0 for r in INCIDENT_RULES)
     assert set(INCIDENT_TRIGGERS) == {
         "burn_alert", "breaker_open", "deadline_exceeded",
-        "window_poisoned", "crash", "sigusr1"}
+        "window_poisoned", "crash", "sigusr1", "probe_failed"}
 
     path = str(tmp_path / "ledger.jsonl")
     mgr = IncidentManager(str(tmp_path / "inc"), cooldown_s=3600.0,

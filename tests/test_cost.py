@@ -401,9 +401,10 @@ def test_cost_report_renders_chargeback_and_savings(tmp_path):
 def test_tools_inventory_is_complete():
     """The smoke below covers every entry point: pin the inventory so a
     new tool must join the contract."""
-    assert len(_TOOLS) == 18
+    assert len(_TOOLS) == 19
     assert {"cost_report", "fleet_dash", "incident_report",
-            "ledger_summary", "obs_diff", "serve_loadgen"} <= set(_TOOLS)
+            "ledger_summary", "obs_diff", "probe_report",
+            "serve_loadgen"} <= set(_TOOLS)
 
 
 @pytest.mark.parametrize("tool", _TOOLS)
@@ -433,6 +434,7 @@ def test_tool_help_contract(tool, monkeypatch, capsys):
     ("incident_report", ["nope.bundle"]),
     ("ledger_summary", ["nope.jsonl"]),
     ("obs_diff", ["nope.jsonl", "nope.jsonl"]),
+    ("probe_report", ["nope.jsonl"]),
     ("trace_view", ["nope.jsonl"]),
     ("xplane_top_ops", ["nope_trace_dir"]),
 ])

@@ -1,0 +1,642 @@
+"""Correctness plane tests (ISSUE 20).
+
+Layers under test, bottom up:
+
+  * ``obs/probe.py`` — the known-answer suite, probe by probe, against
+    scriptable JSON-API fakes: cached-replay exact-zero, determinism
+    hash flips, golden-quality bands, store round-trips, the 400
+    admission contract, traceparent echo, dead-target containment;
+  * ``AnswerAudit`` — majority vote, earliest-observed tie-break,
+    reference seeding (the across-restarts anchor), PROBE_AUDIT_FIELDS;
+  * ``serve/prober.py`` — run_once over a faked fleet: quarantine of
+    the divergent replica, lift on re-agreement, divergence-incident
+    dedup, the router's quarantine exemption, tsdb/ledger/signals
+    emission;
+  * PROBE_RULES obs_diff teeth and the seeded probes section;
+  * THE acceptance (slow): a 2-replica fleet where replica 0 serves
+    silently WRONG bytes with HTTP 200 — every self-check passes, the
+    cross-replica answer audit flags it, the router quarantines it, the
+    fleet keeps serving bit-correct answers, and the run regresses
+    against the healthy baseline through obs_diff.
+"""
+
+import importlib.util
+import os
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        f"{name}_under_probe_test", os.path.join(_REPO, "tools", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ------------------------------------------------- scriptable fakes -----
+
+_CANARY = dict(image_path="data/rabbit", prompt="a rabbit is jumping",
+               prompts=["a rabbit is jumping", "a origami rabbit is jumping"])
+
+_SHA_A = "aa" * 32
+_SHA_B = "bb" * 32
+_SHA_C = "cc" * 32
+
+
+class _FakeClient:
+    """A JSON-API shaped engine fake with a scriptable answer.
+
+    ``sha`` is the content hash every wait() returns (mutate it to model
+    a replica whose answer changes); ``flip_hash`` returns a fresh hash
+    per wait (a non-deterministic replica); ``echo_trace`` is True
+    (echo), False (echo garbage) or None (tracing off — no trace_id).
+    """
+
+    def __init__(self, *, fingerprint="fp-tiny", sha=_SHA_A, src_err=0.0,
+                 status="done", psnr=30.0, ssim=0.9, store_hit=True,
+                 store_source="memory", echo_trace=True, reject_bad=True,
+                 flip_hash=False, dead=False, metrics=None):
+        self.fingerprint = fingerprint
+        self.sha = sha
+        self.src_err = src_err
+        self.status = status
+        self.psnr = psnr
+        self.ssim = ssim
+        self.store_hit = store_hit
+        self.store_source = store_source
+        self.echo_trace = echo_trace
+        self.reject_bad = reject_bad
+        self.flip_hash = flip_hash
+        self.dead = dead
+        self._metrics = metrics
+        self.submitted = []
+        self._pending = {}
+        self._n = 0
+
+    def submit(self, request, *, traceparent=None):
+        if self.dead:
+            raise ConnectionError("connection refused")
+        if self.reject_bad and int(request.get("steps") or 0) > 9000:
+            raise RuntimeError(
+                f"/v1/edits failed with HTTP 400: steps="
+                f"{request['steps']} not warmed")
+        self.submitted.append(dict(request))
+        rid = f"rid{len(self.submitted)}"
+        self._pending[rid] = (dict(request), traceparent)
+        return rid
+
+    def wait(self, rid, *, timeout_s=600.0):
+        request, traceparent = self._pending[rid]
+        self._n += 1
+        sha = f"{self._n:064x}" if self.flip_hash else self.sha
+        rec = {"status": self.status, "src_err": self.src_err,
+               "content_sha256": sha, "store_hit": self.store_hit,
+               "store_source": self.store_source}
+        from videop2p_tpu.obs.probe import PROBE_TENANT
+        if request.get("tenant") == PROBE_TENANT:
+            rec["edit_psnr"] = self.psnr
+            rec["edit_ssim"] = self.ssim
+        if traceparent is not None and self.echo_trace is not None:
+            rec["trace_id"] = (traceparent.split("-")[1]
+                               if self.echo_trace else "f00d" * 8)
+        return rec
+
+    def healthz(self):
+        return {"status": "ok"}
+
+    def metrics(self):
+        if self._metrics is not None:
+            return dict(self._metrics)
+        return {"spec_fingerprint": self.fingerprint}
+
+
+# ------------------------------------------------------ probe suite -----
+
+
+def test_suite_schema_order_and_canary_pinning():
+    """Every probe record carries exactly PROBE_EVENT_FIELDS, the suite
+    runs the single-target probes in PROBE_KINDS order, and the canary is
+    FORCED onto the reserved probe tenant with a pinned seed — so every
+    submission is the same known-answer request."""
+    from videop2p_tpu.obs.probe import (
+        PROBE_EVENT_FIELDS,
+        PROBE_KINDS,
+        PROBE_TENANT,
+        ProbeSuite,
+    )
+
+    suite = ProbeSuite(dict(_CANARY))
+    assert suite.canary["tenant"] == PROBE_TENANT
+    assert suite.canary["seed"] == 8888
+    assert suite.canary["save_name"] == "probe_canary"
+    # a caller-pinned seed/save_name survives; the tenant never does
+    pinned = ProbeSuite(dict(_CANARY, seed=7, save_name="x", tenant="evil"))
+    assert pinned.canary["seed"] == 7
+    assert pinned.canary["save_name"] == "x"
+    assert pinned.canary["tenant"] == PROBE_TENANT
+
+    fake = _FakeClient()
+    records = suite.run(fake, "replica0")
+    assert [r["probe"] for r in records] == [
+        k for k in PROBE_KINDS if k != "store_roundtrip"]
+    for rec in records:
+        assert set(rec) == set(PROBE_EVENT_FIELDS)
+        assert rec["target"] == "replica0"
+        assert rec["ok"], rec
+    # every canary submission rode the probe lane with the pinned seed
+    assert all(r["tenant"] == PROBE_TENANT for r in fake.submitted)
+    assert all(r["seed"] == 8888 for r in fake.submitted)
+
+
+def test_cached_replay_demands_exact_zero():
+    """The paper's own invariant: src_err must be EXACTLY 0.0 — a
+    near-zero replay error is already a broken cached-replay path."""
+    from videop2p_tpu.obs.probe import ProbeSuite
+
+    suite = ProbeSuite(dict(_CANARY))
+    assert suite.probe_cached_replay(_FakeClient(), "r")["ok"]
+    rec = suite.probe_cached_replay(_FakeClient(src_err=1e-9), "r")
+    assert not rec["ok"] and "src_err" in rec["detail"]
+    assert not suite.probe_cached_replay(
+        _FakeClient(status="error"), "r")["ok"]
+
+
+def test_determinism_catches_hash_flip():
+    from videop2p_tpu.obs.probe import ProbeSuite
+
+    suite = ProbeSuite(dict(_CANARY))
+    rec = suite.probe_determinism(_FakeClient(sha=_SHA_A), "r")
+    assert rec["ok"] and rec["content_sha256"] == _SHA_A
+    assert rec["detail"] == "bit-identical"
+    flip = suite.probe_determinism(_FakeClient(flip_hash=True), "r")
+    assert not flip["ok"] and "hashes=" in flip["detail"]
+    # a missing hash can never pass (nothing to prove identical)
+    assert not suite.probe_determinism(_FakeClient(sha=""), "r")["ok"]
+
+
+def test_golden_quality_band():
+    from videop2p_tpu.obs.probe import ProbeSuite
+
+    suite = ProbeSuite(dict(_CANARY))
+    assert suite.probe_golden_quality(_FakeClient(), "r")["ok"]
+    assert not suite.probe_golden_quality(_FakeClient(psnr=2.0), "r")["ok"]
+    assert not suite.probe_golden_quality(_FakeClient(ssim=1.5), "r")["ok"]
+    # a replica that never computed the metrics (probe lane broken) fails
+    assert not suite.probe_golden_quality(
+        _FakeClient(psnr=None, ssim=None), "r")["ok"]
+    tight = ProbeSuite(dict(_CANARY), psnr_band=(35.0, 40.0))
+    assert not tight.probe_golden_quality(_FakeClient(psnr=30.0), "r")["ok"]
+
+
+def test_store_roundtrip_cross_replica_invariant():
+    from videop2p_tpu.obs.probe import ProbeSuite
+
+    suite = ProbeSuite(dict(_CANARY))
+    src = _FakeClient(sha=_SHA_A)
+    hit = _FakeClient(sha=_SHA_A, store_hit=True, store_source="disk")
+    assert suite.probe_store_roundtrip(src, hit, "r0->r1")["ok"]
+    miss = _FakeClient(sha=_SHA_A, store_hit=False, store_source=None)
+    assert not suite.probe_store_roundtrip(src, miss, "r0->r1")["ok"]
+    # a hit that hands back DIFFERENT bytes is the worst case of all
+    wrong = _FakeClient(sha=_SHA_B, store_hit=True, store_source="disk")
+    rec = suite.probe_store_roundtrip(src, wrong, "r0->r1")
+    assert not rec["ok"] and "match=False" in rec["detail"]
+
+
+def test_contract_unwarmed_steps_must_reject():
+    from videop2p_tpu.obs.probe import ProbeSuite
+
+    suite = ProbeSuite(dict(_CANARY))
+    rec = suite.probe_contract_unwarmed_steps(_FakeClient(), "r")
+    assert rec["ok"] and "HTTP 400" in rec["detail"]
+    admitted = suite.probe_contract_unwarmed_steps(
+        _FakeClient(reject_bad=False), "r")
+    assert not admitted["ok"] and "ADMITTED" in admitted["detail"]
+
+
+def test_contract_traceparent_echo():
+    from videop2p_tpu.obs.probe import ProbeSuite
+
+    suite = ProbeSuite(dict(_CANARY))
+    rec = suite.probe_contract_traceparent(_FakeClient(), "r")
+    assert rec["ok"] and "echoed=" in rec["detail"]
+    assert not suite.probe_contract_traceparent(
+        _FakeClient(echo_trace=False), "r")["ok"]
+    # absence of tracing is a configuration, not a bug
+    off = suite.probe_contract_traceparent(_FakeClient(echo_trace=None), "r")
+    assert off["ok"] and "tracing off" in off["detail"]
+
+
+def test_dead_target_is_failed_probes_not_an_exception():
+    """Probing must never take the prober down with the replica: a dead
+    target yields one failed record per probe, exception name inside."""
+    from videop2p_tpu.obs.probe import PROBE_EVENT_FIELDS, ProbeSuite
+
+    suite = ProbeSuite(dict(_CANARY))
+    records = suite.run(_FakeClient(dead=True), "replica0")
+    assert len(records) == 5
+    for rec in records:
+        assert set(rec) == set(PROBE_EVENT_FIELDS)
+        assert not rec["ok"]
+        assert "ConnectionError" in rec["detail"]
+        assert rec["content_sha256"] == ""
+
+
+# ----------------------------------------------------- answer audit -----
+
+
+def test_answer_audit_majority_and_earliest_tiebreak():
+    from videop2p_tpu.obs.probe import PROBE_AUDIT_FIELDS, AnswerAudit
+
+    audit = AnswerAudit()
+    audit.observe("fp", "replica0", _SHA_A)
+    audit.observe("fp", "replica1", _SHA_A)
+    audit.observe("fp", "replica2", _SHA_B)
+    divs = audit.divergences()
+    assert len(divs) == 1
+    d = divs[0]
+    assert set(d) == set(PROBE_AUDIT_FIELDS)
+    assert d["divergent"] == "replica2" == d["replica_b"]
+    assert d["replica_a"] == "replica0"
+    assert d["hash_a"] == _SHA_A and d["hash_b"] == _SHA_B
+    assert d["targets"] == 3 and d["hashes"] == 2
+    assert audit.divergent_targets() == ["replica2"]
+    assert audit.summary() == {
+        "fingerprints": 1, "targets": 3, "divergences": 1,
+        "divergent": ["replica2"], "ok": False}
+
+    # a 1-vs-1 tie breaks toward the EARLIEST observed hash: a standing
+    # fleet's answer beats a later divergent restart
+    tie = AnswerAudit()
+    tie.observe("fp", "replica0", _SHA_A)
+    tie.observe("fp", "replica1", _SHA_B)
+    assert tie.divergent_targets() == ["replica1"]
+
+    # failed probes have no answer to audit
+    empty = AnswerAudit()
+    empty.observe("", "replica0", _SHA_A)
+    empty.observe("fp", "replica0", "")
+    assert empty.observed == {} and empty.summary()["ok"]
+
+
+def test_answer_audit_reference_seed_beats_majority():
+    """A seeded known answer (the across-restarts anchor) outvotes any
+    live majority: if the WHOLE fleet restarts wrong, every replica is
+    divergent — agreement among wrong answers proves nothing."""
+    from videop2p_tpu.obs.probe import AnswerAudit
+
+    audit = AnswerAudit({"fp": _SHA_C})
+    audit.observe("fp", "replica0", _SHA_A)
+    audit.observe("fp", "replica1", _SHA_A)
+    divs = audit.divergences()
+    assert audit.divergent_targets() == ["replica0", "replica1"]
+    assert all(d["replica_a"] == "reference" and d["hash_a"] == _SHA_C
+               for d in divs)
+    # one live replica matching the seed becomes the named holder
+    audit.observe("fp", "replica2", _SHA_C)
+    assert all(d["replica_a"] == "replica2" for d in audit.divergences())
+
+
+# ----------------------------------------------------- fleet prober -----
+
+
+def _faked_prober(fakes, canary=None, **kw):
+    """A FleetProber over unreachable URLs with its clients swapped for
+    scriptable fakes — run_once never opens a socket."""
+    from videop2p_tpu.serve.prober import FleetProber
+
+    prober = FleetProber(
+        [(name, "http://invalid.invalid:1") for name in fakes],
+        dict(canary or _CANARY), interval_s=3600.0, **kw)
+    for tgt in prober.targets:
+        tgt.client = fakes[tgt.name]
+    return prober
+
+
+def test_prober_quarantines_divergent_replica_and_lifts(tmp_path):
+    """run_once over a faked fleet: the wrong-but-healthy replica passes
+    every self-check yet is flagged by the audit and quarantined; the
+    SAME persistent divergence is one audit event, not one per round;
+    re-agreement lifts the quarantine on the next round."""
+    from videop2p_tpu.obs import RunLedger, read_ledger
+    from videop2p_tpu.obs.probe import PROBE_AUDIT_FIELDS, PROBE_EVENT_FIELDS
+    from videop2p_tpu.obs.signals import S_PROBE_SUCCESS
+
+    fakes = {"replica0": _FakeClient(sha=_SHA_A),
+             "replica1": _FakeClient(sha=_SHA_B),   # wrong-but-healthy
+             "router": _FakeClient(sha=_SHA_A, metrics={
+                 "replicas": {"replica0": {"spec_fingerprint": "fp-tiny"},
+                              "replica1": {"spec_fingerprint": "fp-tiny"}}})}
+    path = str(tmp_path / "ledger.jsonl")
+    with RunLedger(path) as led:
+        prober = _faked_prober(fakes, ledger=led)
+        summary = prober.run_once(now=1.0)
+        assert summary["divergences"] == 1
+        assert summary["divergent"] == ["replica1"]
+        status = prober.probe_status()
+        assert status["replica1"] == "quarantine"
+        assert status["router"] == "pass"
+        # the ring store round-trip against a wrong peer legitimately
+        # fails hash-match, so the healthy neighbour reads "fail" (which
+        # does NOT route around it — only "quarantine" does)
+        assert status["replica0"] == "fail"
+        stats = prober.stats()
+        assert stats["quarantined"] == ["replica1"]
+        assert stats["divergences"] == 1
+        assert stats["rounds"] == 1
+        # 5 suite probes x 3 targets + 2 ring round-trips
+        assert stats["probes"] == 17
+
+        # a PERSISTENT divergence dedups to one audit event per hash
+        prober.run_once(now=2.0)
+        assert prober.divergences == 1
+        # re-agreement lifts the quarantine the very next round
+        fakes["replica1"].sha = _SHA_A
+        prober.run_once(now=3.0)
+        assert prober.probe_status() == {
+            "replica0": "pass", "replica1": "pass", "router": "pass"}
+        assert prober.stats()["quarantined"] == []
+        assert prober.audit.summary()["ok"]
+
+        # the tsdb carries per-(target, probe) success series
+        assert prober.tsdb.series(S_PROBE_SUCCESS, {
+            "target": "replica1", "probe": "determinism"})
+
+    by_kind = {}
+    for e in read_ledger(path):
+        by_kind.setdefault(e["event"], []).append(e)
+    assert set(PROBE_EVENT_FIELDS) <= set(by_kind["probe"][0])
+    assert len(by_kind["probe_audit"]) == 1
+    audit_e = by_kind["probe_audit"][0]
+    assert set(PROBE_AUDIT_FIELDS) <= set(audit_e)
+    assert audit_e["divergent"] == "replica1"
+    assert audit_e["hash_a"] == _SHA_A and audit_e["hash_b"] == _SHA_B
+
+
+def test_prober_router_exempt_and_push_channels():
+    """A divergent ROUTER is audited and reported but never quarantined
+    (there is no routing around the router); verdicts and divergences
+    ride the signals push channel; failures and divergences fire the
+    probe_failed incident trigger."""
+
+    class _Recorder:
+        def __init__(self):
+            self.pushes, self.triggers, self.registered = [], [], []
+
+        def set_probe_status(self, status, divergences=()):
+            self.pushes.append((dict(status), list(divergences)))
+
+        def register_target(self, name, probe):
+            self.registered.append(name)
+
+        def trigger(self, kind, detail="", **context):
+            self.triggers.append((kind, detail, context))
+
+    rec = _Recorder()
+    fakes = {"replica0": _FakeClient(sha=_SHA_A),
+             "replica1": _FakeClient(sha=_SHA_A),
+             "router": _FakeClient(sha=_SHA_B, metrics={
+                 "replicas": {"replica0": {"spec_fingerprint": "fp-tiny"},
+                              "replica1": {"spec_fingerprint": "fp-tiny"}}})}
+    prober = _faked_prober(fakes, signals=rec, incidents=rec)
+    assert rec.registered == ["probe:replica0", "probe:replica1",
+                              "probe:router"]
+    summary = prober.run_once(now=1.0)
+    assert summary["divergent"] == ["router"]
+    assert prober.stats()["quarantined"] == []
+    assert prober.probe_status()["router"] == "pass"
+    status, divs = rec.pushes[-1]
+    assert status == prober.probe_status()
+    assert divs and divs[0]["divergent"] == "router"
+    audits = [t for t in rec.triggers if "answer audit" in t[1]]
+    assert len(audits) == 1
+    kind, detail, ctx = audits[0]
+    assert kind == "probe_failed"
+    assert _SHA_B[:12] in detail and _SHA_A[:12] in detail
+    assert ctx["replica_b"] == "router"
+
+    # a target whose probes FAIL (without divergence) also pages
+    fakes["replica1"].src_err = 0.5
+    prober.run_once(now=2.0)
+    assert prober.probe_status()["replica1"] == "fail"
+    failed = [t for t in rec.triggers
+              if t[2].get("target") == "replica1"]
+    assert failed and "cached_replay" in failed[-1][2]["failed"]
+
+
+def test_probe_rules_ride_default_rules_with_teeth():
+    """Verdict pin: PROBE_RULES ride DEFAULT_RULES (kind "probe"),
+    obs/history.py extracts the probes section with the overall label
+    SEEDED perfect — so a probes-off healthy baseline still holds the
+    label a chaos run's first divergence regresses against."""
+    from videop2p_tpu.obs.history import (
+        DEFAULT_RULES,
+        PROBE_RULES,
+        evaluate_rules,
+        extract_run,
+    )
+
+    assert all(r in DEFAULT_RULES for r in PROBE_RULES)
+    assert all(r.kind == "probe" for r in PROBE_RULES)
+    assert {r.metric for r in PROBE_RULES} == {
+        "success_rate", "divergences", "latency_p99_s"}
+
+    healthy = extract_run([{"event": "run_start"}])
+    assert healthy["probes"] == {"probe": {
+        "success_rate": 1.0, "failures": 0.0, "divergences": 0.0}}
+
+    probed = extract_run([
+        {"event": "run_start"},
+        {"event": "probe", "probe": "determinism", "target": "replica0",
+         "ok": True, "latency_s": 0.2, "content_sha256": _SHA_A,
+         "detail": "bit-identical"},
+        {"event": "probe", "probe": "determinism", "target": "replica1",
+         "ok": False, "latency_s": 0.2, "content_sha256": "",
+         "detail": "hash flip"},
+        {"event": "probe_audit", "fingerprint": "fp", "targets": 2,
+         "hashes": 2, "divergent": "replica1", "replica_a": "replica0",
+         "hash_a": _SHA_A, "replica_b": "replica1", "hash_b": _SHA_B},
+    ])
+    overall = probed["probes"]["probe"]
+    assert overall["count"] == 2.0
+    assert overall["success_rate"] == 0.5
+    assert overall["failures"] == 1.0
+    assert overall["divergences"] == 1.0
+    assert probed["probes"]["probe:replica1"]["divergences"] == 1.0
+    assert probed["probes"]["probe:replica0"]["success_rate"] == 1.0
+
+    # teeth: healthy-vs-probed regresses; self-compare passes both ways
+    verdict = evaluate_rules(healthy, probed)
+    assert not verdict["pass"]
+    flagged = {f["metric"] for f in verdict["regressions"]}
+    assert {"success_rate", "divergences"} <= flagged
+    assert evaluate_rules(probed, probed)["pass"]
+    assert evaluate_rules(healthy, healthy)["pass"]
+
+
+def test_loadgen_probe_flag_validation():
+    """--probes exercises the real JSON API — an --inproc engine has no
+    HTTP surface to probe, so the pairing is refused at arg-parse."""
+    loadgen = _load_tool("serve_loadgen")
+    with pytest.raises(SystemExit):
+        loadgen.main(["--inproc", "--probes"])
+
+
+# --------------------------------------- live acceptance (slow, CPU) -----
+
+_SPEC_KW = dict(checkpoint=None, tiny=True, width=16, video_len=2, steps=2)
+_PROMPTS = ("a rabbit is jumping", "a origami rabbit is jumping")
+
+
+@pytest.fixture(scope="module")
+def programs():
+    """One warm tiny ProgramSet shared by every fleet in this module."""
+    from videop2p_tpu.serve import ProgramSet, ProgramSpec
+
+    ps = ProgramSet(ProgramSpec(**_SPEC_KW))
+    ps.warm(_PROMPTS, batch_sizes=(2,))
+    return ps
+
+
+def _request(**overrides):
+    from videop2p_tpu.serve import EditRequest
+
+    kw = dict(image_path="data/rabbit", prompt=_PROMPTS[0],
+              prompts=list(_PROMPTS), save_name="fleet")
+    kw.update(overrides)
+    return EditRequest(**kw)
+
+
+def _probed_fleet_run(programs, root, *, faults=None, reference=None,
+                      seed=81):
+    """A 2-replica fleet + router with the FleetProber's verdicts wired
+    into the router — the composition tools/serve_loadgen.py --router 2
+    --probes stands up. One deterministic probe round runs BEFORE the
+    loadgen traffic so quarantine is in force while requests flow."""
+    from videop2p_tpu.serve import ReplicaSupervisor, Router, RouterServer
+    from videop2p_tpu.serve.prober import FleetProber
+
+    loadgen = _load_tool("serve_loadgen")
+    sup = ReplicaSupervisor(
+        programs.spec, 2, out_dir=root, programs=programs,
+        warm_prompts=_PROMPTS,
+        engine_kwargs=dict(max_retries=0, breaker_threshold=1,
+                           breaker_open_s=60.0),
+        faults=faults or {},
+    )
+    sup.start()
+    router = Router(sup.urls, probe_ttl_s=0.05, suspend_s=5.0)
+    server = RouterServer(router).start()
+    targets = ([(r.name, r.url) for r in sup.replicas]
+               + [("router", server.url)])
+    prober = FleetProber(targets, _request(seed=seed).to_dict(),
+                         interval_s=3600.0, http_timeout_s=300.0,
+                         wait_s=300.0, reference=reference)
+    router.set_probe_status_provider(prober.probe_status)
+    ledger_path = os.path.join(root, "loadgen.jsonl")
+    try:
+        prober.run_once()
+
+        def collect_extra(record):
+            events = [{"event": kind, **rec}
+                      for kind, rec in prober.history]
+            events.append({"event": "router_health",
+                           **router.health_record()})
+            record["probes"] = prober.stats()
+            return events
+
+        record = loadgen.run_loadgen(
+            loadgen._HttpTarget(server.url, timeout_s=300.0),
+            _request(seed=seed).to_dict(),
+            requests=4, concurrency=2, ledger_path=ledger_path,
+            meta={"target": "fleet-prober"}, collect_extra=collect_extra,
+        )
+    finally:
+        server.close()
+        sup.stop()
+    return record, ledger_path, prober, router
+
+
+@pytest.mark.slow
+def test_probe_acceptance_wrong_replica_quarantined(programs, tmp_path):
+    """THE ISSUE 20 acceptance: replica 0 serves silently WRONG bytes
+    with HTTP 200 — src_err, PSNR/SSIM and its own determinism all pass,
+    so Layers 1-8 see a healthy replica. The answer audit (seeded with
+    the healthy run's known answer) flags it, the router quarantines it
+    and keeps serving bit-correct answers from replica 1, the router's
+    /healthz///metrics expose the verdict, and the run regresses against
+    the healthy baseline through obs_diff's PROBE_RULES."""
+    from videop2p_tpu.obs import read_ledger
+
+    healthy_root = str(tmp_path / "healthy")
+    wrong_root = str(tmp_path / "wrong")
+    os.makedirs(healthy_root)
+    os.makedirs(wrong_root)
+
+    # healthy pass: every probe green, zero divergences, all-pass verdicts
+    h_record, h_ledger, h_prober, _ = _probed_fleet_run(
+        programs, healthy_root, seed=81)
+    assert h_record["done"] == 4 and h_record["errors"] == 0
+    assert h_record["probes"]["probe_failures"] == 0
+    assert h_record["probes"]["divergences"] == 0
+    assert h_record["probes"]["quarantined"] == []
+    assert h_record["probes"]["audit"]["ok"]
+    assert set(h_record["probes"]["status"].values()) == {"pass"}
+    # the healthy fleet agreed on ONE known answer — seed the next audit
+    # with it: the across-restarts anchor
+    (fp, seen), = h_prober.audit.observed.items()
+    assert len(set(seen.values())) == 1
+    reference = {fp: next(iter(seen.values()))}
+
+    # wrong pass: replica 0 perturbs every answer, HTTP 200 throughout
+    c_record, c_ledger, c_prober, c_router = _probed_fleet_run(
+        programs, wrong_root, faults={0: "wrong:*"}, seed=81,
+        reference=reference)
+    # the audit named the wrong replica; the router quarantined it
+    assert "replica0" in c_prober.audit.divergent_targets()
+    assert c_record["probes"]["quarantined"] == ["replica0"]
+    assert c_record["probes"]["status"]["replica0"] == "quarantine"
+    assert c_record["probes"]["divergences"] >= 1
+    # ... and the fleet KEPT SERVING: every request done, and replica 1
+    # still returns the bit-exact healthy answer (it matches the seeded
+    # reference, so real traffic routed around the quarantine is correct)
+    assert c_record["done"] == 4 and c_record["errors"] == 0
+    assert c_prober.audit.observed[fp]["replica1"] == reference[fp]
+    assert "replica1" not in c_prober.audit.divergent_targets()
+    assert c_router.health_record()["quarantined"] >= 1
+    # the wrong replica is deterministic about its wrong answer — every
+    # self-check passed; ONLY the cross-replica audit caught it
+    r0 = [e for e in read_ledger(c_ledger)
+          if e.get("event") == "probe" and e.get("target") == "replica0"]
+    assert r0 and all(e["ok"] for e in r0)
+
+    # satellite (b): the router's own surfaces expose the verdict
+    router_health = [e for e in read_ledger(c_ledger)
+                     if e.get("event") == "router_health"]
+    assert router_health[-1]["quarantined"] >= 1
+    audits = [e for e in read_ledger(c_ledger)
+              if e.get("event") == "probe_audit"]
+    assert audits and audits[0]["divergent"] == "replica0"
+    assert audits[0]["hash_a"] == reference[fp]
+    assert audits[0]["hash_b"] != reference[fp]
+
+    # gates: self-compare clean, wrong-vs-healthy regresses on PROBE_RULES
+    obs_diff = _load_tool("obs_diff")
+    assert obs_diff.main(["obs_diff.py", h_ledger, h_ledger]) == 0
+    assert obs_diff.main(["obs_diff.py", h_ledger, c_ledger]) == 1
+
+    # both ledgers render: the dashboard's correctness panel and the
+    # standalone probe report mark the divergence
+    fleet_dash = _load_tool("fleet_dash")
+    probe_report = _load_tool("probe_report")
+    for ledger in (h_ledger, c_ledger):
+        text = open(fleet_dash.write_dash(ledger)).read()
+        assert "Correctness" in text
+        rtext = open(probe_report.write_probe_report(ledger)).read()
+        assert rtext.startswith("<!doctype html>")
+    wrong_dash = open(fleet_dash.write_dash(c_ledger)).read()
+    assert "replica0" in wrong_dash

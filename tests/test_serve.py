@@ -450,6 +450,10 @@ def test_engine_second_identical_request_is_compile_free(engine):
     assert rec2["compile_events"] == 0
     assert rec2["src_err"] == 0.0
     assert np.array_equal(engine.videos(r1), engine.videos(r2))
+    # ISSUE 20 satellite (c): the record's stable answer identity agrees
+    # with the tensors — the determinism probe keys on exactly this hash
+    assert rec1["content_sha256"] == rec2["content_sha256"]
+    assert len(rec1["content_sha256"]) == 64
     # the store's trajectory write-through landed in the disk layer
     hit_key = rec2["store_key"]
     traj, _ = load_persisted_inversion(engine.store.persist_dir, hit_key)

@@ -200,8 +200,12 @@ def test_prometheus_golden_format():
         "requests": {"done": 3, "error": 1},
         "tenants": {"a": {"error_rate": 0.0, "requests": 2,
                           "device_seconds": 1.5}},
+        # ISSUE 20: the router's per-replica probe verdicts — quarantined
+        # bool becomes a 1/0 gauge, the probe_status string is skipped
         "replicas": {"r0": {"healthy": True, "requests": {"done": 3},
-                            "nan_gauge": float("nan")}},
+                            "nan_gauge": float("nan"),
+                            "probe_status": "pass",
+                            "quarantined": False}},
         "inf_gauge": float("inf"),
     }
     assert render_prometheus(metrics) == (
@@ -221,6 +225,8 @@ def test_prometheus_golden_format():
         + 'videop2p_replica_healthy{replica="r0"} 1\n'
         + _hdr("videop2p_replica_nan_gauge")
         + 'videop2p_replica_nan_gauge{replica="r0"} NaN\n'
+        + _hdr("videop2p_replica_quarantined")
+        + 'videop2p_replica_quarantined{replica="r0"} 0\n'
         + _hdr("videop2p_replica_requests_total")
         + 'videop2p_replica_requests_total{replica="r0",status="done"} 3\n'
         + _hdr("videop2p_requests_total")
@@ -525,6 +531,9 @@ def test_router_replica_traceparent_round_trip(traced_fleet, tmp_path,
     text = client.metrics_prometheus()
     assert "# TYPE videop2p_replica_requests_total gauge" in text
     assert 'videop2p_replica_in_flight{replica="replica0"} 0' in text
+    # ISSUE 20 satellite (b): the per-replica quarantine verdict rides
+    # the same exposition (no prober wired → nobody quarantined)
+    assert 'videop2p_replica_quarantined{replica="replica0"} 0' in text
     rtext = EngineClient(sup.urls[0]).metrics_prometheus()
     assert "# TYPE videop2p_queue_depth gauge" in rtext
 

@@ -22,7 +22,10 @@ evaluations and the ``fleet_series`` tsdb snapshot a
   * **cost panel (ISSUE 19)** — engine utilization/padding-waste and the
     per-tenant attributed device-seconds from the run's
     ``cost_attribution`` chargeback rows (full showback:
-    ``tools/cost_report.py``).
+    ``tools/cost_report.py``);
+  * **correctness panel (ISSUE 20)** — per-target known-answer probe
+    verdicts and every cross-replica answer-audit divergence with its
+    content-hash pair (full report: ``tools/probe_report.py``).
 
 Everything is inline (CSS + SVG, no external assets) — the output ships
 in a bug report. Tolerates signal-only ledgers (no snapshot event → no
@@ -153,6 +156,8 @@ def render_dash(events: Sequence[Dict[str, Any]],
     sigs = [e for e in events if e.get("event") == "fleet_signals"]
     incidents = [e for e in events if e.get("event") == "incident"]
     costs = [e for e in events if e.get("event") == "cost_attribution"]
+    probes = [e for e in events if e.get("event") == "probe"]
+    audits = [e for e in events if e.get("event") == "probe_audit"]
     snap = next((e for e in reversed(events)
                  if e.get("event") == "fleet_series"), None)
     body: List[str] = [
@@ -243,6 +248,37 @@ def render_dash(events: Sequence[Dict[str, Any]],
         if ten_rows:
             body.append(_table(ten_rows, ["tenant", "requests", "device_s",
                                           "flops", "saved_device_s"]))
+    # correctness panel (ISSUE 20): the prober's known-answer verdicts —
+    # per-target pass/fail counts plus every answer-audit divergence with
+    # its hash pair; full report: tools/probe_report.py
+    if probes or audits:
+        divergent = {str(a.get("divergent")) for a in audits}
+        tallies: Dict[str, List[int]] = {}
+        for e in probes:
+            t = tallies.setdefault(str(e.get("target", "?")), [0, 0])
+            t[0] += 1
+            t[1] += 0 if e.get("ok") else 1
+        prows = [[tname, _fmt(n), _fmt(bad),
+                  ("DIVERGENT — quarantine" if tname in divergent
+                   else ("failing" if bad else "ok"))]
+                 for tname, (n, bad) in sorted(tallies.items())]
+        pmarks = [("bad" if r[3] != "ok" else "") for r in prows]
+        body.append("<h2>Correctness probes</h2>"
+                    "<p class=meta>known-answer canaries + cross-replica "
+                    "answer audit (probe/probe_audit events); full "
+                    "report: tools/probe_report.py &lt;ledger&gt;.</p>")
+        if prows:
+            body.append(_table(prows, ["target", "probes", "failed",
+                                       "verdict"], pmarks))
+        if audits:
+            arows = [[str(a.get("divergent", "?")),
+                      str(a.get("hash_b", ""))[:16],
+                      str(a.get("replica_a", "?")),
+                      str(a.get("hash_a", ""))[:16]]
+                     for a in audits]
+            body.append(_table(arows, ["divergent", "its hash",
+                                       "reference", "ref hash"],
+                               ["bad"] * len(arows)))
     if incidents:
         irows = [[_fmt(e.get("t", "")), str(e.get("trigger", "?")),
                   str(e.get("detail", ""))[:120],

@@ -32,7 +32,11 @@ overall or per trigger kind, regresses — gated by ``INCIDENT_RULES``),
 and cost & capacity (``cost_attribution`` events — obs/cost.py:
 per-engine/tenant/program device-second attribution; cost-per-request
 and padding/idle waste regress by growing, utilization by dropping —
-gated by ``COST_RULES``)
+gated by ``COST_RULES``), and correctness probes (``probe`` /
+``probe_audit`` events — obs/probe.py + serve/prober.py: known-answer
+success rates regress by DROPPING, ANY new cross-replica answer-audit
+divergence regresses, probe latency p99 by growing — gated by
+``PROBE_RULES``)
 between a baseline run and a new run, renders per-program tables,
 evaluates the declarative regression rules (obs/history.py DEFAULT_RULES;
 scale every threshold with ``--threshold-scale``), and:
@@ -460,6 +464,36 @@ def render_diff(base: Dict, new: Dict, result: Dict) -> str:
                 "captured or suppressed bundles regresses):",
                 _table(inc_rows, ["label", "bundles", "suppressed",
                                   "ring_events"])]
+
+    # correctness section (probe / probe_audit events — obs/probe.py,
+    # ISSUE 20): the overall "probe" label is seeded perfect on every
+    # run, so the table only renders when either side actually probed
+    # (or audited a divergence)
+    probes = sorted(set(base.get("probes") or {})
+                    | set(new.get("probes") or {}))
+    probe_rows = []
+    for label in probes:
+        b = (base.get("probes") or {}).get(label, {})
+        n = (new.get("probes") or {}).get(label, {})
+        if not (b.get("count") or n.get("count")
+                or b.get("divergences") or n.get("divergences")):
+            continue
+        probe_rows.append([
+            label,
+            f"{_fmt(b.get('count', 0.0))} → {_fmt(n.get('count', 0.0))}",
+            f"{_fmt(b.get('success_rate', 1.0))} → "
+            f"{_fmt(n.get('success_rate', 1.0))}",
+            f"{_fmt(b.get('failures', 0.0))} → "
+            f"{_fmt(n.get('failures', 0.0))}",
+            f"{_fmt(b.get('divergences', 0.0))} → "
+            f"{_fmt(n.get('divergences', 0.0))}",
+        ])
+    if probe_rows:
+        out += ["", "correctness probes (probe/probe_audit — success "
+                "rate regresses by dropping; ANY new answer-audit "
+                "divergence regresses):",
+                _table(probe_rows, ["label", "probes", "success_rate",
+                                    "failures", "divergences"])]
 
     comp = sorted(set(base.get("compiles", {})) | set(new.get("compiles", {})))
     if comp:

@@ -59,6 +59,21 @@ land in the SAME loadgen ledger: ``tools/obs_diff.py`` gates them via
     python tools/serve_loadgen.py --router 2 --tiny --requests 16 \
         --collector --window_scale 0.02 --ledger fleet.jsonl
     python tools/fleet_dash.py fleet.jsonl
+
+Correctness plane (ISSUE 20): ``--probes`` runs a
+``videop2p_tpu.serve.prober.FleetProber`` alongside the closed loop —
+the known-answer probe suite (cached replay, determinism, golden
+quality, store round-trip, contract probes) fired at every replica +
+the router every ``--probe_interval_s`` under the reserved low-priority
+``probe`` tenant, with canary content hashes audited fleet-wide. The
+``probe``/``probe_audit`` trail lands in the SAME ledger (gated by
+``PROBE_RULES``, rendered by ``tools/probe_report.py``); in ``--router``
+mode the router consumes the prober's verdicts and routes around
+quarantined wrong-answer replicas:
+
+    python tools/serve_loadgen.py --router 2 --tiny --requests 16 \
+        --probes --collector --window_scale 0.02 --ledger fleet.jsonl
+    python tools/probe_report.py fleet.jsonl
 """
 
 from __future__ import annotations
@@ -486,6 +501,18 @@ def main(argv=None) -> int:
                          "land in THIS ledger (obs_diff INCIDENT_RULES "
                          "gate any increase) — render bundles with "
                          "tools/incident_report.py")
+    ap.add_argument("--probes", action="store_true",
+                    help="correctness plane (ISSUE 20): run a FleetProber "
+                         "known-answer loop against the target (every "
+                         "replica + the router in --router mode) for the "
+                         "duration of the run — probe verdicts and "
+                         "cross-replica answer-audit divergences land in "
+                         "THIS ledger (gate with obs_diff PROBE_RULES, "
+                         "render with tools/probe_report.py); in --router "
+                         "mode the router quarantines divergent replicas")
+    ap.add_argument("--probe_interval_s", type=float, default=5.0,
+                    help="prober round cadence (each round runs the full "
+                         "suite — several real canary edits per target)")
     ap.add_argument("--scrape_interval_s", type=float, default=0.5,
                     help="collector scrape/evaluate cadence")
     ap.add_argument("--window_scale", type=float, default=1.0,
@@ -549,6 +576,10 @@ def main(argv=None) -> int:
     if args.collector and args.inproc:
         ap.error("--collector scrapes HTTP surfaces — use --router N or "
                  "--url (an --inproc engine has no /metrics endpoint)")
+    if args.probes and args.inproc:
+        ap.error("--probes exercises the real JSON API — use --router N "
+                 "or --url (an --inproc engine has no HTTP surface to "
+                 "probe)")
 
     request = {
         "image_path": args.image,
@@ -762,6 +793,45 @@ def main(argv=None) -> int:
                                  **collector.stats()}
             return events
 
+    prober = None
+    if args.probes:
+        from videop2p_tpu.serve.prober import FleetProber
+
+        # share the collector's tsdb + signal engine when both planes are
+        # on: probe_success/probe_latency series land next to the scraped
+        # gauges and the fleet_signals evaluations carry the probe burn
+        prober = FleetProber(
+            scrape_targets, dict(request),
+            interval_s=args.probe_interval_s,
+            http_timeout_s=args.timeout_s,
+            wait_s=args.timeout_s,
+            tsdb=collector.tsdb if collector is not None else None,
+            signals=collector.signals if collector is not None else None,
+            incidents=incident_mgr,
+        )
+        if args.router:
+            # close the loop: the router consumes the prober's verdicts
+            # and routes around quarantined wrong-answer replicas
+            router.set_probe_status_provider(prober.probe_status)
+        prober.start()
+        meta["probes"] = {"targets": [n for n, _ in scrape_targets],
+                          "probe_interval_s": args.probe_interval_s}
+        print(f"[loadgen] prober running the known-answer suite against "
+              f"{len(scrape_targets)} target(s) every "
+              f"{args.probe_interval_s}s")
+        base_probe = collect_extra
+
+        def collect_extra(record, base=base_probe, prober=prober):
+            # stop the probing loop (one final round if none completed)
+            # and drain its probe/probe_audit trail into THIS ledger —
+            # the same file then gates correctness via PROBE_RULES
+            events = list(base(record) or []) if base is not None else []
+            prober.stop(final_round=True)
+            events += [{"event": kind, **rec}
+                       for kind, rec in prober.history]
+            record["probes"] = prober.stats()
+            return events
+
     if incident_mgr is not None:
         base_inc = collect_extra
 
@@ -793,6 +863,8 @@ def main(argv=None) -> int:
             slo=args.slo,
         )
     finally:
+        if prober is not None:
+            prober.stop(final_round=False)  # no-op when drained
         if collector is not None:
             collector.stop(final_evaluate=False)  # no-op when drained
         if router_server is not None:

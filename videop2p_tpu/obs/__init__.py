@@ -95,6 +95,16 @@ Pillars (ISSUEs 2–4):
     divergence measurements gated by ``COMM_RULES`` (divergence must be
     0.0, zero noise floor).
 
+  * :mod:`videop2p_tpu.obs.probe` — the correctness plane (ISSUE 20):
+    declarative known-answer probes against the real serving API
+    (cached-replay, determinism, golden quality, store round-trip,
+    contract probes) emitted as ``probe`` ledger events, plus the
+    cross-replica :class:`AnswerAudit` — canary content hashes keyed by
+    ProgramSpec fingerprint must agree fleet-wide; divergences become
+    ``probe_audit`` events, ``probe_failed`` incidents and router
+    quarantine, gated by ``PROBE_RULES`` (``serve/prober.py`` is the
+    scheduling loop, ``tools/probe_report.py`` the report).
+
 Everything here is OFF by default: with no active ledger and
 ``telemetry=False`` the fused programs are bit-identical to their
 un-instrumented forms (tests/test_obs.py pins this).
@@ -135,6 +145,7 @@ from videop2p_tpu.obs.history import (
     DEFAULT_RULES,
     FAULT_RULES,
     INCIDENT_RULES,
+    PROBE_RULES,
     QUALITY_RULES,
     SEGMENT_RULES,
     SIGNAL_RULES,
@@ -164,6 +175,14 @@ from videop2p_tpu.obs.ledger import (
     instrumented_jit,
     program_label,
     read_ledger,
+)
+from videop2p_tpu.obs.probe import (
+    PROBE_AUDIT_FIELDS,
+    PROBE_EVENT_FIELDS,
+    PROBE_KINDS,
+    PROBE_TENANT,
+    AnswerAudit,
+    ProbeSuite,
 )
 from videop2p_tpu.obs.quality import (
     adjacent_frame_psnr,
@@ -285,6 +304,13 @@ __all__ = [
     "SIGNAL_RULES",
     "INCIDENT_RULES",
     "COST_RULES",
+    "PROBE_RULES",
+    "PROBE_AUDIT_FIELDS",
+    "PROBE_EVENT_FIELDS",
+    "PROBE_KINDS",
+    "PROBE_TENANT",
+    "AnswerAudit",
+    "ProbeSuite",
     "CAPACITY_FIELDS",
     "COST_ATTRIBUTION_FIELDS",
     "REQUEST_COST_FIELDS",

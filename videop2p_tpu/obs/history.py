@@ -49,6 +49,7 @@ __all__ = [
     "SIGNAL_RULES",
     "INCIDENT_RULES",
     "COST_RULES",
+    "PROBE_RULES",
     "split_runs",
     "extract_run",
     "evaluate_rules",
@@ -78,7 +79,10 @@ class RegressionRule:
     aggregated from ``span`` events — queue/resolve/dispatch/decode), or
     ``"cost"`` (cost & capacity attribution from ``cost_attribution``
     events, obs/cost.py — cost-per-request, busy/idle fraction, padding
-    waste). ``min_abs`` suppresses verdicts
+    waste), or ``"probe"`` (active-probing correctness from ``probe`` /
+    ``probe_audit`` events, obs/probe.py — known-answer success rates,
+    cross-replica answer-audit divergences, probe latency tails).
+    ``min_abs`` suppresses verdicts
     whose absolute delta is noise-sized (a 0.001 s phase doubling is not a
     regression). ``programs`` (labels for program/compile/dispatch kinds,
     phase names for phases) restricts the rule; None applies it everywhere.
@@ -278,6 +282,27 @@ COST_RULES: Tuple[RegressionRule, ...] = (
                    min_abs=0.05),
 )
 
+# correctness-plane gates (ISSUE 20): active-probing verdicts from
+# `probe` / `probe_audit` events (obs/probe.py, serve/prober.py). The
+# known-answer success rate regresses by DROPPING with a zero band plus
+# a 1% floor — probes are deterministic canaries, not sampled traffic,
+# so any failed probe is signal. Answer-audit divergences follow the
+# incident pattern (any-increase: threshold_pct=0 with a 0.5 floor) —
+# the healthy baseline is ZERO replicas disagreeing about the canary's
+# bytes, and the overall "probe" label is seeded so a probes-off
+# baseline still gates a chaos run's first divergence. Probe latency
+# p99 gets a wide band + absolute floor: canaries ride the reserved
+# low-priority tenant, so their tails are noisy by design and only a
+# gross slowdown (the probe path itself wedging) should flag.
+PROBE_RULES: Tuple[RegressionRule, ...] = (
+    RegressionRule("success_rate", kind="probe", direction="decrease",
+                   threshold_pct=0.0, min_abs=0.01),
+    RegressionRule("divergences", kind="probe", threshold_pct=0.0,
+                   min_abs=0.5),
+    RegressionRule("latency_p99_s", kind="probe", threshold_pct=50.0,
+                   min_abs=0.5),
+)
+
 DEFAULT_RULES: Tuple[RegressionRule, ...] = (
     RegressionRule("flops", threshold_pct=10.0),
     RegressionRule("bytes_accessed", threshold_pct=15.0, min_abs=1 << 20),
@@ -288,7 +313,7 @@ DEFAULT_RULES: Tuple[RegressionRule, ...] = (
     RegressionRule("seconds", kind="phase", threshold_pct=25.0, min_abs=0.5),
 ) + (QUALITY_RULES + COMM_RULES + TIMING_RULES + FAULT_RULES + SEAM_RULES
      + SLO_RULES + SEGMENT_RULES + SIGNAL_RULES + INCIDENT_RULES
-     + COST_RULES)
+     + COST_RULES + PROBE_RULES)
 
 
 def split_runs(events: Iterable[Dict[str, Any]]) -> List[List[Dict[str, Any]]]:
@@ -363,8 +388,17 @@ def extract_run(events: Sequence[Dict[str, Any]],
         # cost_attribution events has no cost SURFACE to regress, so an
         # old baseline simply shares no labels and extracts clean)
         "cost": {},
+        # correctness-plane section (ISSUE 20): known-answer probe
+        # verdicts per target from `probe` events plus answer-audit
+        # divergences from `probe_audit` events, gated by PROBE_RULES.
+        # The overall "probe" label is SEEDED perfect (like incidents'
+        # zero) so a probes-off healthy baseline still holds the label
+        # a chaos run's first divergence regresses against.
+        "probes": {"probe": {"success_rate": 1.0, "failures": 0.0,
+                             "divergences": 0.0}},
     }
     seg_samples: Dict[str, List[float]] = {}
+    probe_samples: Dict[str, Tuple[List[float], List[float]]] = {}
     for e in events:
         kind = e.get("event")
         if kind == "program_analysis":
@@ -592,6 +626,29 @@ def extract_run(events: Sequence[Dict[str, Any]],
                 if k not in ("event", "t", "label", "scope", "name")
                 and isinstance(v, (int, float)) and not isinstance(v, bool)
             }
+        elif kind == "probe":
+            # one known-answer probe verdict (ISSUE 20, obs/probe.py):
+            # accumulate pass/fail + latency overall and per target;
+            # finalized into success rates / p99 after the scan
+            labels = ["probe"]
+            if e.get("target"):
+                labels.append(f"probe:{e['target']}")
+            for label in labels:
+                oks, lats = probe_samples.setdefault(label, ([], []))
+                oks.append(1.0 if e.get("ok") else 0.0)
+                try:
+                    lats.append(float(e.get("latency_s") or 0.0))
+                except (TypeError, ValueError):
+                    pass
+        elif kind == "probe_audit":
+            # one answer-audit divergence (the wrong-but-healthy
+            # signature): counts accumulate overall and per divergent
+            # target so PROBE_RULES' any-increase gate names the replica
+            for label in ("probe", f"probe:{e.get('divergent') or '?'}"):
+                m = rec["probes"].setdefault(
+                    label, {"success_rate": 1.0, "failures": 0.0,
+                            "divergences": 0.0})
+                m["divergences"] = m.get("divergences", 0.0) + 1.0
         elif kind == "incident":
             # capture counts accumulate over the run, overall AND per
             # trigger kind — INCIDENT_RULES then flags any label that
@@ -615,6 +672,17 @@ def extract_run(events: Sequence[Dict[str, Any]],
             "max_s": round(max(durations), 6),
             "total_s": round(sum(durations), 6),
         }
+    for label, (oks, lats) in sorted(probe_samples.items()):
+        m = rec["probes"].setdefault(
+            label, {"success_rate": 1.0, "failures": 0.0,
+                    "divergences": 0.0})
+        m["count"] = float(len(oks))
+        m["success_rate"] = round(sum(oks) / len(oks), 6) if oks else 1.0
+        m["failures"] = float(len(oks) - int(sum(oks)))
+        if lats:
+            # latency lands only when real samples exist — a seeded-only
+            # baseline must not offer a 0.0 the p99 rule inflates against
+            m["latency_p99_s"] = round(percentile(lats, 99), 6)
     return rec
 
 
@@ -648,9 +716,10 @@ def _rule_values(record: Dict[str, Any], rule: RegressionRule) -> Dict[str, floa
     elif rule.kind == "divergence":
         out = {k: float(v) for k, v in record.get("divergence", {}).items()}
     elif rule.kind in ("timing", "trace", "reliability", "stream", "slo",
-                       "segment", "signal", "incident", "cost"):
+                       "segment", "signal", "incident", "cost", "probe"):
         section = {"segment": "segments", "signal": "signals",
-                   "incident": "incidents"}.get(rule.kind, rule.kind)
+                   "incident": "incidents",
+                   "probe": "probes"}.get(rule.kind, rule.kind)
         for label, m in record.get(section, {}).items():
             if rule.metric in m:
                 out[label] = float(m[rule.metric])

@@ -89,6 +89,10 @@ INCIDENT_TRIGGERS = (
     "window_poisoned",
     "crash",
     "sigusr1",
+    # ISSUE 20: a failed known-answer probe or a cross-replica answer
+    # divergence (serve/prober.py) — the bundle carries the offending
+    # canary request, both content hashes and the flight ring
+    "probe_failed",
 )
 
 _DEFAULT_COOLDOWN_S = 60.0

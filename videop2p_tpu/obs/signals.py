@@ -74,6 +74,8 @@ __all__ = [
     "S_BUSY_FRACTION",
     "S_PADDING_WASTE",
     "S_COST_PER_REQUEST",
+    "S_PROBE_SUCCESS",
+    "S_PROBE_LATENCY",
 ]
 
 # ---- the series-name contract between collector and signals --------------
@@ -95,6 +97,10 @@ S_TENANT = "tenant_total"   # cumulative, labels {replica, tenant, field}
 S_BUSY_FRACTION = "busy_fraction"           # 0..1 gauge, labels {replica}
 S_PADDING_WASTE = "padding_waste"           # gauge, labels {replica}
 S_COST_PER_REQUEST = "cost_per_request_s"   # gauge, labels {replica}
+# ISSUE 20 correctness plane: the prober writes one 1/0 sample per
+# known-answer probe run plus its wall latency, labels {target, probe}
+S_PROBE_SUCCESS = "probe_success"           # 1/0, labels {target, probe}
+S_PROBE_LATENCY = "probe_latency"           # seconds, labels {target, probe}
 
 # request statuses that mean "the engine failed the request" vs finished
 ERROR_STATUSES = ("error", "deadline_exceeded")
@@ -142,6 +148,15 @@ FLEET_SIGNALS_FIELDS = (
     "headroom_rps",
     "utilization_slope",
     "utilization_forecast",
+    # correctness plane (ISSUE 20): known-answer probe health measured
+    # from the prober's series + the audit's quarantine verdicts pushed
+    # through :meth:`SignalEngine.set_probe_status`. success_rate is
+    # None and quarantined [] when no prober runs — probe-off fleets
+    # evaluate exactly as before.
+    "probe_success_rate",
+    "probe_failures",
+    "probe_divergences",
+    "quarantined",
     "scale_advice",
     "reasons",
 )
@@ -240,6 +255,11 @@ class SignalEngine:
         # satellite): the collector pushes them from each target's
         # /metrics `programs` reservoirs; the tsdb stays scalar-only
         self._exemplars: Dict[str, Dict[str, Optional[str]]] = {}
+        # correctness plane (ISSUE 20): the prober's pushed per-target
+        # verdicts and audit divergences — names/hashes don't fit the
+        # scalar tsdb, so they ride a side channel like the exemplars
+        self._probe_status: Dict[str, str] = {}
+        self._probe_divergences: List[Dict[str, Any]] = []
 
     def set_exemplars(
             self, exemplars: Dict[str, Dict[str, Optional[str]]]) -> None:
@@ -251,6 +271,16 @@ class SignalEngine:
                      "max_trace_id": (v or {}).get("max_trace_id")}
             for k, v in (exemplars or {}).items()
         }
+
+    def set_probe_status(self, status: Dict[str, str],
+                         divergences: Sequence[Dict[str, Any]] = ()) -> None:
+        """The prober's push channel (ISSUE 20): per-target probe
+        verdicts (``pass``/``fail``/``quarantine``) and the answer
+        audit's divergence records, so a quarantine recommendation can
+        NAME the divergent replica and both hashes."""
+        self._probe_status = {str(k): str(v)
+                              for k, v in (status or {}).items()}
+        self._probe_divergences = [dict(d) for d in (divergences or ())]
 
     def _exemplar_hint(self) -> Optional[str]:
         """One offending trace id for the advice reasons — the dispatch
@@ -476,6 +506,21 @@ class SignalEngine:
                          for lane in tenants.values())
         economics = self._capacity_signals(t, demand_rps)
 
+        # correctness plane (ISSUE 20): probe success over the slow
+        # window across every (target, probe) series the prober wrote —
+        # no prober means no series and None, the probe-off baseline
+        probe_vals: List[float] = []
+        for ls in self.tsdb.labelsets(S_PROBE_SUCCESS):
+            probe_vals.extend(
+                v for _, v in self.tsdb.window(
+                    S_PROBE_SUCCESS, t, self.slow_window_s, ls)
+                if not math.isnan(v))
+        probe_success_rate = ((sum(probe_vals) / len(probe_vals))
+                              if probe_vals else None)
+        probe_failures = sum(1 for v in probe_vals if v < 1.0)
+        quarantined = sorted(k for k, v in self._probe_status.items()
+                             if v == "quarantine")
+
         # ---- scale advice ------------------------------------------------
         reasons: List[str] = []
         exemplar_hint = self._exemplar_hint()
@@ -498,6 +543,22 @@ class SignalEngine:
             reasons.append(
                 f"replicas down {replicas_total - replicas_up}/"
                 f"{replicas_total}")
+        # probe-failure burn + the quarantine recommendation (ISSUE 20):
+        # a wrong-but-healthy replica is lost capacity the liveness
+        # signals cannot see — name it, with both hashes
+        if probe_failures:
+            reasons.append(
+                f"probe failures {probe_failures}"
+                + (f" (success_rate {probe_success_rate:.2f})"
+                   if probe_success_rate is not None else ""))
+        for name in quarantined:
+            d = next((d for d in self._probe_divergences
+                      if d.get("divergent") == name), None)
+            reasons.append(
+                f"quarantine {name}: answer diverges from fleet"
+                + (f" ({str(d.get('hash_b', ''))[:12]} != "
+                   f"{str(d.get('hash_a', ''))[:12]} vs "
+                   f"{d.get('replica_a')})" if d else ""))
         if reasons:
             advice = "grow"
         else:
@@ -587,6 +648,12 @@ class SignalEngine:
             "headroom_rps": economics["headroom_rps"],
             "utilization_slope": economics["utilization_slope"],
             "utilization_forecast": economics["utilization_forecast"],
+            "probe_success_rate": (round(probe_success_rate, 4)
+                                   if probe_success_rate is not None
+                                   else None),
+            "probe_failures": probe_failures,
+            "probe_divergences": len(self._probe_divergences),
+            "quarantined": quarantined,
             "scale_advice": advice,
             "reasons": reasons,
         }

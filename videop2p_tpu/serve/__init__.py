@@ -42,6 +42,14 @@ DDIM inversions per edit of the same clip. This package keeps both warm:
     TimeSeriesStore` (gaps recorded for dead replicas, never
     interpolated) and evaluates ``obs/signals.py`` burn-rate/trend/
     demand signals on the same cadence.
+  * :mod:`videop2p_tpu.serve.prober` — the correctness plane's
+    scheduler (ISSUE 20): :class:`FleetProber` runs the
+    ``obs/probe.py`` known-answer suite against every replica + the
+    router on a deterministic interval under the reserved ``probe``
+    tenant, feeds ``probe_success``/``probe_latency`` tsdb series,
+    audits canary content hashes fleet-wide and serves per-replica
+    quarantine verdicts to the router's pluggable ``probe_status``
+    provider.
   * :mod:`videop2p_tpu.serve.faults` — the resilience layer's primitives
     (ISSUE 9): deterministic fault injection (:class:`FaultPlan`), the
     jitter-free :class:`RetryPolicy`, the :class:`CircuitBreaker`, and the
@@ -62,6 +70,7 @@ from videop2p_tpu.serve.batching import (
 )
 from videop2p_tpu.serve.client import EngineClient, engine_available
 from videop2p_tpu.serve.collector import FleetCollector
+from videop2p_tpu.serve.prober import FleetProber
 from videop2p_tpu.serve.engine import TERMINAL_STATUSES, EditEngine, EditRequest
 from videop2p_tpu.serve.faults import (
     CircuitBreaker,
@@ -101,6 +110,7 @@ __all__ = [
     "EngineClient",
     "engine_available",
     "FleetCollector",
+    "FleetProber",
     "EditEngine",
     "EditRequest",
     "TERMINAL_STATUSES",

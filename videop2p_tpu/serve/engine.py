@@ -65,6 +65,7 @@ Stdlib+numpy+jax only — the import-guard test walks this package.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import queue
@@ -100,6 +101,7 @@ from videop2p_tpu.serve.faults import (
     is_transient,
 )
 from videop2p_tpu.obs.cost import CostModel
+from videop2p_tpu.obs.probe import PROBE_TENANT
 from videop2p_tpu.obs.spans import (
     Tracer,
     make_span_id,
@@ -1391,6 +1393,29 @@ class EditEngine:
 
         rec = self.poll(rid)
         req = rec["request"]
+        if self.faults is not None and self.faults.wrong:
+            # silent wrong-answer seam (wrong:PAT): deterministically
+            # perturb the tensor — the replica stays self-consistent
+            # (same bytes every replay, 200s, healthy /healthz) but its
+            # content hash diverges from the fleet's, which only the
+            # cross-replica answer audit (obs/probe.py) catches
+            if self.faults.wrongs(rec.get("store_key") or rid):
+                videos = np.ascontiguousarray(np.asarray(videos)[..., ::-1])
+        # stable answer identity: byte hash of the full video tensor —
+        # the determinism probe and the bit-exactness tests compare THIS,
+        # not re-hashed GIF artifacts
+        content_sha256 = hashlib.sha256(
+            np.ascontiguousarray(np.asarray(videos)).tobytes()).hexdigest()
+        quality = None
+        if rec.get("tenant") == PROBE_TENANT:
+            # golden-quality canary metrics — computed ONLY for the
+            # reserved probe tenant (this one check is the entire
+            # probe-off overhead on the serving hot path)
+            from videop2p_tpu.obs.quality import psnr, ssim
+            quality = {
+                "edit_psnr": round(float(psnr(videos[1], videos[0])), 4),
+                "edit_ssim": round(float(ssim(videos[1], videos[0])), 4),
+            }
         tid = rec.get("trace_id") if self._tracing else None
         t_dec0 = time.perf_counter() if tid else None
         req_dir = os.path.join(self.out_dir, rid)
@@ -1461,7 +1486,8 @@ class EditEngine:
             rid, "done",
             dispatch_s=round(dispatch_s, 4), total_s=round(total, 4),
             src_err=src_err, compile_events=compile_events,
-            cost=cost,
+            cost=cost, content_sha256=content_sha256,
+            **(quality or {}),
             inversion_gif=inversion_gif, edit_gif=edit_gif,
         )
         self.ledger.event(

@@ -19,10 +19,17 @@ Plan DSL (comma-separated directives; also accepted as a JSON object):
     long enough windows must trip the circuit breaker);
   * ``corrupt:PAT``  — persisted store entries whose key contains ``PAT``
     (``*`` = every key) load corrupted (the rehydration path must detect
-    and fall back to a fresh inversion, never serve garbage).
+    and fall back to a fresh inversion, never serve garbage);
+  * ``wrong:PAT``    — finished requests whose store key (or request id)
+    contains ``PAT`` (``*`` = every request) return a deterministically
+    perturbed video tensor while still answering 200 and passing
+    ``/healthz`` — the *wrong-but-healthy* replica only the cross-replica
+    answer audit (obs/probe.py, ISSUE 20) can catch. Deterministic by
+    design: the replica stays self-consistent (the determinism probe
+    passes) but its content hash diverges from the fleet's.
 
 JSON form: ``{"fail": [2, 3], "hang": {"4": 1.5}, "unavail": [5, 7],
-"corrupt": ["*"]}``.
+"corrupt": ["*"], "wrong": ["*"]}``.
 
 The env var ``VIDEOP2P_SERVE_FAULTS`` (or ``cli/serve.py --faults`` /
 ``tools/serve_loadgen.py --faults``) activates a plan process-wide.
@@ -178,6 +185,7 @@ class FaultPlan:
         hang: Optional[Dict[int, float]] = None,
         unavail: Optional[Tuple[int, int]] = None,
         corrupt: Sequence[str] = (),
+        wrong: Sequence[str] = (),
         spec: str = "",
     ):
         self.fail = frozenset(int(k) for k in fail)
@@ -185,6 +193,7 @@ class FaultPlan:
         self.unavail = (None if unavail is None
                         else (int(unavail[0]), int(unavail[1])))
         self.corrupt = tuple(str(p) for p in corrupt)
+        self.wrong = tuple(str(p) for p in wrong)
         self.spec = spec
         self.injected: List[Dict[str, Any]] = []
         # observer hook (the engine sets it to its fault-event recorder so
@@ -210,12 +219,14 @@ class FaultPlan:
                 hang=hang,
                 unavail=tuple(unavail) if unavail else None,
                 corrupt=list(d.get("corrupt") or ()),
+                wrong=list(d.get("wrong") or ()),
                 spec=spec,
             )
         fail: List[int] = []
         hang = {}
         unavail = None
         corrupt: List[str] = []
+        wrong: List[str] = []
         for part in spec.split(","):
             part = part.strip()
             if not part:
@@ -231,15 +242,17 @@ class FaultPlan:
                     unavail = (int(a), int(b or a))
                 elif part.startswith("corrupt:"):
                     corrupt.append(part[8:] or "*")
+                elif part.startswith("wrong:"):
+                    wrong.append(part[6:] or "*")
                 else:
                     raise ValueError(part)
             except (ValueError, TypeError):
                 raise ValueError(
                     f"bad fault directive {part!r} — expected fail@K, "
-                    "hang@K:S, unavail@A-B or corrupt:PAT"
+                    "hang@K:S, unavail@A-B, corrupt:PAT or wrong:PAT"
                 ) from None
         return cls(fail=fail, hang=hang, unavail=unavail, corrupt=corrupt,
-                   spec=spec)
+                   wrong=wrong, spec=spec)
 
     @classmethod
     def from_env(cls) -> Optional["FaultPlan"]:
@@ -277,6 +290,17 @@ class FaultPlan:
         hit = any(p == "*" or p in key for p in self.corrupt)
         if hit:
             self._record("store_corrupt", key=key)
+        return hit
+
+    def wrongs(self, key: str) -> bool:
+        """The engine's answer seam: does this finished request return a
+        silently wrong (deterministically perturbed) video tensor? Unlike
+        :meth:`corrupts`, nothing downstream detects this — the replica
+        answers 200 with a stable-but-divergent content hash, which is
+        exactly what the cross-replica answer audit exists to catch."""
+        hit = any(p == "*" or p in key for p in self.wrong)
+        if hit:
+            self._record("wrong_output", key=key)
         return hit
 
     def _record(self, kind: str, **fields: Any) -> None:

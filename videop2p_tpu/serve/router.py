@@ -63,7 +63,7 @@ __all__ = ["Router", "RouterServer", "make_router_server",
 # reliability section (label "router") so FAULT_RULES-style gates apply.
 ROUTER_HEALTH_FIELDS = (
     "replicas", "healthy", "submitted", "routed", "retries",
-    "routed_around", "rejected", "proxy_errors",
+    "routed_around", "rejected", "proxy_errors", "quarantined",
 )
 
 
@@ -90,6 +90,10 @@ class _ReplicaView:
         self.suspended_until = 0.0
         self.consecutive_failures = 0
         self.routed = 0
+        # correctness-plane verdict (ISSUE 20): set by rank() from the
+        # pluggable probe_status provider; True routes AROUND this
+        # replica exactly like an open breaker
+        self.quarantined = False
         self._probe: Optional[Tuple[float, Dict[str, Any], Dict[str, Any]]] = None
         self._lock = threading.Lock()
 
@@ -160,6 +164,7 @@ class Router:
         ledger_path: Optional[str] = None,
         tracing: bool = False,
         incidents: Any = None,
+        probe_status: Any = None,
     ):
         urls = [str(u) for u in replica_urls if str(u).strip()]
         if not urls:
@@ -191,8 +196,14 @@ class Router:
         self._lock = threading.Lock()
         self.counters: Dict[str, int] = {
             "submitted": 0, "routed": 0, "retries": 0, "routed_around": 0,
-            "rejected": 0, "proxy_errors": 0,
+            "rejected": 0, "proxy_errors": 0, "quarantined": 0,
         }
+        # correctness plane (ISSUE 20): a pluggable provider returning
+        # {replica_name: "pass" | "fail" | "quarantine"} — the prober's
+        # answer-audit verdicts. "quarantine" routes around the replica
+        # like an open breaker. None (the default): zero per-request
+        # overhead beyond one None check in rank().
+        self._probe_status_provider = probe_status
         self.started = time.perf_counter()
         self._closed = False
         # incident plane (ISSUE 18): a dir string means the router OWNS a
@@ -225,6 +236,19 @@ class Router:
         with self._lock:
             self.counters[name] = self.counters.get(name, 0) + n
 
+    def set_probe_status_provider(self, provider: Any) -> None:
+        """Wire (or clear) the probe-verdict provider after construction
+        — the prober is usually built after the router it protects."""
+        self._probe_status_provider = provider
+
+    def _probe_statuses(self) -> Dict[str, str]:
+        if self._probe_status_provider is None:
+            return {}
+        try:
+            return dict(self._probe_status_provider() or {})
+        except Exception:  # noqa: BLE001 — a broken prober must not stop routing
+            return {}
+
     def rank(self) -> Tuple[List[_ReplicaView], List[_ReplicaView]]:
         """``(candidates, avoided)`` — candidates ordered best-first by
         (healthy, load, p99, index); ``avoided`` is every replica skipped
@@ -233,10 +257,14 @@ class Router:
         rather than rejecting everything)."""
         scored = []
         avoided = []
+        statuses = self._probe_statuses()
         for i, v in enumerate(self.views):
             health, metrics = v.probe(self.probe_ttl_s)
             healthy = bool(health.get("ok")) and health.get("status") == "ok"
-            bad = (not healthy) or v.suspended
+            # a quarantined replica is wrong-but-healthy: it answers 200
+            # and passes /healthz, so only the probe verdict demotes it
+            v.quarantined = statuses.get(v.name) == "quarantine"
+            bad = (not healthy) or v.suspended or v.quarantined
             if bad:
                 avoided.append(v)
             load = 0
@@ -307,6 +335,10 @@ class Router:
                     if avoided_ids and id(view) not in avoided_ids:
                         # an unhealthy replica was routed AROUND
                         self.counters["routed_around"] += 1
+                        if any(a.quarantined for a in avoided):
+                            # ... and at least one of them for being
+                            # WRONG, not merely down (ISSUE 20)
+                            self.counters["quarantined"] += 1
                 view.routed += 1
                 view.consecutive_failures = 0
                 if self.ledger is not None:
@@ -396,6 +428,7 @@ class Router:
         ``ok``; dashboards read the per-replica map."""
         per = {}
         healthy = 0
+        statuses = self._probe_statuses()
         for v in self.views:
             health, _ = v.probe(self.probe_ttl_s)
             ok = bool(health.get("ok")) and health.get("status") == "ok"
@@ -407,6 +440,10 @@ class Router:
                 "suspended": v.suspended,
                 "breaker": health.get("breaker"),
                 "warm": health.get("warm"),
+                # correctness plane (ISSUE 20): clients and the collector
+                # see quarantine here, without reading any ledger
+                "probe_status": statuses.get(v.name),
+                "quarantined": statuses.get(v.name) == "quarantine",
             }
         return {
             "ok": healthy > 0,
@@ -422,6 +459,7 @@ class Router:
         live ``/metrics`` record under its name."""
         per = {}
         fleet_requests: Dict[str, int] = {}
+        statuses = self._probe_statuses()
         for v in self.views:
             _, metrics = v.probe(self.probe_ttl_s)
             age = v.probe_age()
@@ -430,7 +468,14 @@ class Router:
                            # the probe above ran, up to probe_ttl_s when
                            # the TTL cache answered (ISSUE 17)
                            "probe_age_s": (round(age, 6)
-                                           if age is not None else None)}
+                                           if age is not None else None),
+                           # ISSUE 20: the prober's verdict — the string
+                           # rides JSON only, the bool becomes the
+                           # videop2p_replica_quarantined 1/0 gauge in
+                           # the Prometheus exposition
+                           "probe_status": statuses.get(v.name),
+                           "quarantined": statuses.get(v.name)
+                           == "quarantine"}
             for status, n in (metrics.get("requests") or {}).items():
                 fleet_requests[status] = fleet_requests.get(status, 0) + int(n)
         return {
@@ -455,6 +500,7 @@ class Router:
             "routed_around": counters["routed_around"],
             "rejected": counters["rejected"],
             "proxy_errors": counters["proxy_errors"],
+            "quarantined": counters["quarantined"],
             "per_replica": {v.name: v.routed for v in self.views},
         }
 
